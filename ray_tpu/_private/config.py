@@ -1,0 +1,98 @@
+"""Central config-flag system.
+
+Mirrors the reference's single-source-of-truth flag table
+(reference: src/ray/common/ray_config_def.h — ~900 RAY_CONFIG(type, name, default)
+entries, overridable via RAY_<name> env vars). Here every flag is declared once in
+_FLAGS and overridable via ``RTPU_<name>`` environment variables or an explicit
+``system_config`` dict passed at init time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {
+    # --- object store / serialization -------------------------------------
+    # Results at or below this size are returned inline in the task reply and live
+    # in the owner's in-process memory store; larger ones go to plasma.
+    "max_direct_call_object_size": 100 * 1024,
+    # Shared-memory object store capacity per node (bytes).
+    "object_store_memory": 2 * 1024**3,
+    # Chunk size for node-to-node object transfer.
+    "object_manager_chunk_size": 4 * 1024**2,
+    # --- scheduling --------------------------------------------------------
+    # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
+    "scheduler_spread_threshold": 0.5,
+    "worker_lease_timeout_ms": 30_000,
+    # Max idle workers kept alive per node (soft cap, like num_cpus in reference).
+    "idle_worker_keep_alive_s": 120.0,
+    "worker_startup_timeout_s": 60.0,
+    # --- fault tolerance ---------------------------------------------------
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    "health_check_period_ms": 1000,
+    "health_check_failure_threshold": 5,
+    "max_lineage_bytes": 64 * 1024**2,
+    # --- timeouts ----------------------------------------------------------
+    "gcs_rpc_timeout_s": 30.0,
+    "get_timeout_warning_s": 10.0,
+    "resource_report_period_ms": 250,
+    # --- pubsub ------------------------------------------------------------
+    "pubsub_poll_timeout_s": 30.0,
+    "pubsub_max_batch": 1000,
+    # --- task events / observability --------------------------------------
+    "task_events_flush_period_ms": 1000,
+    "task_events_max_buffer": 10_000,
+    "metrics_report_period_ms": 2000,
+    # --- TPU ---------------------------------------------------------------
+    # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
+    "num_tpu_chips": -1,
+    "tpu_pod_type": "",
+}
+
+
+class _Config:
+    """Attribute access over the flag table with env-var overrides.
+
+    Precedence: explicit ``apply_system_config`` > ``RTPU_<name>`` env var > default.
+    """
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._overrides:
+            return self._overrides[name]
+        if name not in _FLAGS:
+            raise AttributeError(f"Unknown config flag: {name}")
+        default = _FLAGS[name]
+        env = os.environ.get(f"RTPU_{name}")
+        if env is None:
+            return default
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes")
+        if isinstance(default, int):
+            return int(env)
+        if isinstance(default, float):
+            return float(env)
+        return env
+
+    def apply_system_config(self, cfg: Dict[str, Any] | str | None):
+        if cfg is None:
+            return
+        if isinstance(cfg, str):
+            cfg = json.loads(cfg)
+        for k, v in cfg.items():
+            if k not in _FLAGS:
+                raise ValueError(f"Unknown config flag: {k}")
+            self._overrides[k] = v
+
+    def dump(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _FLAGS}
+
+
+RTPU_CONFIG = _Config()
