@@ -1,0 +1,53 @@
+"""ray_tpu — a TPU-native distributed AI runtime with the capabilities of Ray.
+
+Core runtime: tasks, actors, a shared-memory object store, ownership-based
+distributed refcounting, resource-aware two-level scheduling, placement
+groups, fault tolerance — plus ML libraries (train/tune/data/serve/rllib)
+whose device plane is jax/XLA/pallas over TPU ICI instead of torch/NCCL.
+"""
+
+from ray_tpu._version import version as __version__  # noqa: F401
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor  # noqa: F401
+from ray_tpu.api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.remote_function import RemoteFunction  # noqa: F401
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+from ray_tpu import exceptions  # noqa: F401
+
+__all__ = [
+    "__version__",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "get_actor",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "get",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+    "RemoteFunction",
+    "get_runtime_context",
+    "exceptions",
+]

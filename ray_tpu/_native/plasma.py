@@ -215,18 +215,26 @@ class PlasmaClient:
             "evicted_count": ev_c.value,
         }
 
-    def close(self):
+    def close(self, unmap: bool = False):
+        """Detach from the store.
+
+        By default the mapping is left in place until process exit: zero-copy
+        values deserialized from the store may still alias it, and unmapping
+        under them would turn later reads into segfaults. Pass unmap=True only
+        when no views can be outstanding (e.g. the raylet destroying the store).
+        """
         if self._handle:
             try:
-                self._view.release()
-            except Exception:
-                pass
-            try:
-                self._mm.close()
                 self._f.close()
             except Exception:
                 pass
-            self._libref.ps_close(self._handle)
+            if unmap:
+                try:
+                    self._view.release()
+                    self._mm.close()
+                except Exception:
+                    pass
+                self._libref.ps_close(self._handle)
             self._handle = None
 
     @staticmethod

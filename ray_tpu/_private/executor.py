@@ -1,0 +1,276 @@
+"""Task execution inside a worker: normal tasks, actor creation, actor tasks.
+
+Counterpart of the reference's TaskReceiver + scheduling queues
+(reference: src/ray/core_worker/transport/task_receiver.cc:36,
+actor_scheduling_queue.h, out_of_order_actor_scheduling_queue.h, fiber.h):
+
+- Normal tasks run one-at-a-time on a dedicated thread (the raylet leases this
+  worker exclusively, so there is never more than one in flight).
+- Actor tasks are totally ordered *per caller* via sequence numbers with a
+  reorder buffer, then dispatched to either a thread pool of size
+  ``max_concurrency`` (sync actors) or a private asyncio loop (async actors —
+  the reference uses fibers; an event loop is the Python-native equivalent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.task_spec import TASK_ACTOR, return_object_ids
+from ray_tpu.exceptions import TaskCancelledError, format_exception
+
+
+class _AsyncActorLoop:
+    """Private event loop thread for async actors."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        t = threading.Thread(target=self._run, name="rtpu-async-actor", daemon=True)
+        t.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+
+class Executor:
+    def __init__(self, core):
+        self.core = core  # CoreWorker
+        self._normal_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
+        # actor state
+        self.actor_instance = None
+        self.actor_id: Optional[bytes] = None
+        self.actor_is_async = False
+        self._actor_pool: Optional[ThreadPoolExecutor] = None
+        self._actor_loop: Optional[_AsyncActorLoop] = None
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        # per-caller ordering: caller_id -> {"expected": int|None, "buffer": {seq: (spec, fut)}}
+        self._callers: Dict[bytes, dict] = {}
+        self._cancelled: set = set()
+        self._current_task_name = ""
+
+    # ----------------------------------------------------------- normal path
+
+    async def execute_normal(self, spec: dict) -> dict:
+        return await self._execute(spec, self._normal_pool)
+
+    # ------------------------------------------------------------ actor path
+
+    async def create_actor(self, spec: dict, actor_id: bytes) -> dict:
+        loop = asyncio.get_running_loop()
+        # functions.fetch may hit the GCS KV through the blocking client — keep
+        # it off the IO loop.
+        cls = await loop.run_in_executor(None, self.core.functions.fetch, spec["fn_key"])
+        args, kwargs, pins = await self._resolve_args(spec)
+
+        def make():
+            return cls(*args, **kwargs)
+
+        try:
+            self.actor_instance = await loop.run_in_executor(self._normal_pool, make)
+        except Exception as e:
+            return {"ok": False, "error": format_exception(e)}
+        finally:
+            del args, kwargs, pins
+        self.actor_id = actor_id
+        self.core.on_became_actor(actor_id, spec)
+        self.actor_is_async = any(
+            inspect.iscoroutinefunction(getattr(type(self.actor_instance), m, None))
+            for m in dir(type(self.actor_instance))
+            if not m.startswith("__")
+        )
+        max_conc = spec.get("max_concurrency", 1)
+        if self.actor_is_async:
+            self._actor_loop = _AsyncActorLoop()
+            self._actor_sem = None  # created lazily on the actor loop
+            self._actor_max_conc = max_conc if max_conc > 1 else 1000
+        else:
+            self._actor_pool = ThreadPoolExecutor(
+                max_workers=max(1, max_conc), thread_name_prefix="rtpu-actor"
+            )
+        return {"ok": True}
+
+    async def push_actor_task(self, spec: dict) -> dict:
+        """Order by (caller_id, seq_no), then execute."""
+        caller = spec.get("caller_id", b"")
+        seq = spec.get("seq_no", 0)
+        state = self._callers.setdefault(caller, {"expected": None, "buffer": {}})
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        state["buffer"][seq] = (spec, fut)
+        if state["expected"] is None:
+            state["expected"] = seq
+        # drain in order
+        while state["expected"] in state["buffer"]:
+            s, f = state["buffer"].pop(state["expected"])
+            state["expected"] += 1
+            asyncio.ensure_future(self._run_actor_task(s, f))
+        return await fut
+
+    async def _run_actor_task(self, spec: dict, fut: asyncio.Future):
+        try:
+            if self.actor_is_async:
+                reply = await self._execute_async_actor(spec)
+            else:
+                reply = await self._execute(spec, self._actor_pool)
+        except Exception as e:
+            reply = {"status": "error", "error": format_exception(e), "app_error": False}
+        if not fut.done():
+            fut.set_result(reply)
+
+    async def _execute_async_actor(self, spec: dict) -> dict:
+        method_name = spec["method_name"]
+        args, kwargs, pins = await self._resolve_args(spec)
+        method = getattr(self.actor_instance, method_name)
+        outer = asyncio.get_running_loop()
+        result_fut = outer.create_future()
+
+        sem_holder = self
+
+        async def run_on_actor_loop():
+            if sem_holder._actor_sem is None:
+                sem_holder._actor_sem = asyncio.Semaphore(sem_holder._actor_max_conc)
+            async with sem_holder._actor_sem:
+                if inspect.iscoroutinefunction(method):
+                    return await method(*args, **kwargs)
+                return method(*args, **kwargs)
+
+        def done_cb(f):
+            def transfer():
+                if result_fut.done():
+                    return
+                if f.cancelled():
+                    result_fut.set_exception(TaskCancelledError())
+                elif f.exception() is not None:
+                    result_fut.set_exception(f.exception())
+                else:
+                    result_fut.set_result(f.result())
+
+            outer.call_soon_threadsafe(transfer)
+
+        inner = asyncio.run_coroutine_threadsafe(run_on_actor_loop(), self._actor_loop.loop)
+        inner.add_done_callback(done_cb)
+        self.core.register_running_task(spec["task_id"], inner)
+        try:
+            result = await result_fut
+            return await self._package_results(spec, result)
+        except Exception as e:
+            return self._error_reply(spec, e)
+        finally:
+            self.core.unregister_running_task(spec["task_id"])
+            del args, kwargs, pins
+
+    # --------------------------------------------------------------- shared
+
+    async def _resolve_args(self, spec: dict):
+        """Deserialize wire args; top-level refs are fetched (zero-copy)."""
+        args: list = []
+        kwargs: dict = {}
+        pins = []  # keep plasma pin handles alive for the call duration
+
+        for kind, key, wire in spec["args"]:
+            if "v" in wire:
+                val, _refs = serialization.deserialize_inline(wire["v"])
+            elif "ref" in wire:
+                id_bytes, owner = wire["ref"]
+                ref = ObjectRef(ObjectID(id_bytes), tuple(owner) if owner else None)
+                val = await self.core.async_get_one(ref)
+                pins.append(val)
+            else:
+                raise ValueError(f"bad wire arg {wire}")
+            if kind == "p":
+                args.append(val)
+            else:
+                kwargs[key] = val
+        return args, kwargs, pins
+
+    async def _execute(self, spec: dict, pool: ThreadPoolExecutor) -> dict:
+        task_id = spec["task_id"]
+        if task_id in self._cancelled:
+            self._cancelled.discard(task_id)
+            return self._error_reply(spec, TaskCancelledError(), cancelled=True)
+        loop = asyncio.get_running_loop()
+        try:
+            if spec["type"] == TASK_ACTOR:
+                fn = getattr(self.actor_instance, spec["method_name"])
+            else:
+                fn = await loop.run_in_executor(
+                    None, self.core.functions.fetch, spec["fn_key"]
+                )
+            args, kwargs, pins = await self._resolve_args(spec)
+        except Exception as e:
+            return {"status": "error", "error": format_exception(e), "app_error": False}
+
+        self.core.task_events.record(spec, "RUNNING")
+        old_ctx = self.core.push_task_context(spec)
+
+        def call():
+            return fn(*args, **kwargs)
+
+        try:
+            result = await loop.run_in_executor(pool, call)
+        except Exception as e:
+            return self._error_reply(spec, e)
+        finally:
+            self.core.pop_task_context(old_ctx)
+            del args, kwargs, pins
+        return await self._package_results(spec, result)
+
+    def _error_reply(self, spec, e: Exception, cancelled=False):
+        self.core.task_events.record(spec, "FAILED", error=str(e)[:500])
+        return {
+            "status": "error",
+            "error": format_exception(e),
+            "exception": serialization.serialize_inline(e)[0],
+            "app_error": True,
+            "cancelled": cancelled,
+        }
+
+    async def _package_results(self, spec: dict, result: Any) -> dict:
+        num_returns = spec["num_returns"]
+        if num_returns == 1:
+            values = [result]
+        elif num_returns == 0:
+            values = []
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                return self._error_reply(
+                    spec,
+                    ValueError(
+                        f"task declared num_returns={num_returns} but returned "
+                        f"{len(values)} values"
+                    ),
+                )
+        return_ids = return_object_ids(spec)
+        results = []
+        loop = asyncio.get_running_loop()
+        for oid, value in zip(return_ids, values):
+            payload, _refs = await loop.run_in_executor(
+                None, serialization.serialize_inline, value
+            )
+            size = len(payload["p"]) + sum(len(b) for b in payload["b"])
+            if size <= self.core.inline_threshold:
+                results.append({"inline": payload})
+            else:
+                meta = await self.core.put_return_to_plasma(oid, payload, spec)
+                results.append({"plasma": meta})
+        self.core.task_events.record(spec, "FINISHED")
+        return {"status": "ok", "results": results}
+
+    def cancel(self, task_id: bytes):
+        self._cancelled.add(task_id)
+        self.core.try_cancel_running(task_id)
+
+    def shutdown(self):
+        self._normal_pool.shutdown(wait=False)
+        if self._actor_pool:
+            self._actor_pool.shutdown(wait=False)

@@ -1,0 +1,780 @@
+"""GCS — the cluster control plane (one per cluster).
+
+TPU-native counterpart of the reference's gcs_server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:78): node membership and
+health, the actor directory with restart fault-tolerance, placement groups
+with two-phase reserve/commit, jobs, a namespaced KV (which also backs the
+function table), long-poll batched pubsub (reference: src/ray/pubsub/), task
+events, and the cluster resource view that feeds scheduling/spillback and the
+autoscaler. Everything runs on one asyncio loop, like the reference's single
+asio io_context.
+
+State is in-memory with an optional JSON-lines append log for KV/job/actor
+tables (GCS restart tolerance; reference uses Redis for this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.rpc import ClientPool, RpcServer
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Actor lifecycle states (reference: protobuf gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class KVStore:
+    def __init__(self):
+        self._data: Dict[str, Dict[bytes, bytes]] = {}
+
+    def _ns(self, ns: str) -> Dict[bytes, bytes]:
+        return self._data.setdefault(ns or "", {})
+
+    def put(self, ns, key, value, overwrite=True) -> bool:
+        table = self._ns(ns)
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    def get(self, ns, key):
+        return self._ns(ns).get(key)
+
+    def delete(self, ns, key) -> bool:
+        return self._ns(ns).pop(key, None) is not None
+
+    def keys(self, ns, prefix=b""):
+        return [k for k in self._ns(ns) if k.startswith(prefix)]
+
+    def exists(self, ns, key) -> bool:
+        return key in self._ns(ns)
+
+
+class PubSub:
+    """Long-poll batched pubsub, one queue per subscriber.
+
+    The reference replaced per-key long-polling with batched channel polling
+    (reference: src/ray/pubsub/README.md); same design here: subscribers poll
+    and receive every buffered (channel, message) batch at once.
+    """
+
+    def __init__(self):
+        self._subs: Dict[bytes, Dict[str, Any]] = {}
+
+    def subscribe(self, sub_id: bytes, channel: str):
+        sub = self._subs.setdefault(
+            sub_id, {"channels": set(), "queue": [], "event": asyncio.Event()}
+        )
+        sub["channels"].add(channel)
+
+    def unsubscribe(self, sub_id: bytes, channel: Optional[str]):
+        sub = self._subs.get(sub_id)
+        if not sub:
+            return
+        if channel is None:
+            del self._subs[sub_id]
+        else:
+            sub["channels"].discard(channel)
+
+    def publish(self, channel: str, message):
+        for sub in self._subs.values():
+            for ch in sub["channels"]:
+                if channel == ch or (ch.endswith("*") and channel.startswith(ch[:-1])):
+                    q = sub["queue"]
+                    q.append([channel, message])
+                    if len(q) > RTPU_CONFIG.pubsub_max_batch:
+                        del q[: len(q) - RTPU_CONFIG.pubsub_max_batch]
+                    sub["event"].set()
+                    break
+
+    async def poll(self, sub_id: bytes, timeout: float):
+        sub = self._subs.setdefault(
+            sub_id, {"channels": set(), "queue": [], "event": asyncio.Event()}
+        )
+        if not sub["queue"]:
+            sub["event"].clear()
+            try:
+                await asyncio.wait_for(sub["event"].wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+        batch = sub["queue"]
+        sub["queue"] = []
+        return batch
+
+
+class GcsServer:
+    def __init__(self, host="127.0.0.1", session_dir: str = ""):
+        self.host = host
+        self.session_dir = session_dir
+        self.server = RpcServer(host)
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        self.pool = ClientPool()  # clients to raylets / workers
+        self.start_time = time.time()
+
+        # node_id(bytes) -> info dict
+        self.nodes: Dict[bytes, dict] = {}
+        self.node_last_beat: Dict[bytes, float] = {}
+        # actor_id(bytes) -> record
+        self.actors: Dict[bytes, dict] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
+        self.pending_actor_queue: List[bytes] = []
+        # pg_id(bytes) -> record
+        self.placement_groups: Dict[bytes, dict] = {}
+        self.pending_pg_queue: List[bytes] = []
+        self.jobs: Dict[bytes, dict] = {}
+        self.task_events: List[dict] = []
+        self._worker_failures: List[dict] = []
+        self._bg_tasks = []
+
+    # ------------------------------------------------------------------ util
+
+    def _raylet_client(self, node_id: bytes):
+        info = self.nodes[node_id]
+        return self.pool.get(info["ip"], info["raylet_port"])
+
+    def alive_nodes(self) -> List[bytes]:
+        return [nid for nid, n in self.nodes.items() if n["state"] == "ALIVE"]
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self, port: int = 0) -> int:
+        self.server.register_all(self)
+        port = await self.server.start(port)
+        self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        logger.info("GCS listening on %s:%s", self.host, port)
+        return port
+
+    async def _health_check_loop(self):
+        period = RTPU_CONFIG.health_check_period_ms / 1000.0
+        threshold = RTPU_CONFIG.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for node_id, info in list(self.nodes.items()):
+                if info["state"] != "ALIVE":
+                    continue
+                last = self.node_last_beat.get(node_id, now)
+                if now - last > period * threshold:
+                    await self._mark_node_dead(node_id, "missed heartbeats")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or info["state"] == "DEAD":
+            return
+        info["state"] = "DEAD"
+        info["end_time"] = time.time()
+        logger.warning("node %s dead: %s", node_id.hex(), reason)
+        self.pubsub.publish("node", {"node_id": node_id, "state": "DEAD"})
+        # Fail/restart actors that lived on this node.
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
+                await self._on_actor_worker_lost(actor_id, f"node died: {reason}")
+        # Re-schedule placement groups that had bundles there.
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg["state"] == "CREATED" and any(
+                b.get("node_id") == node_id for b in pg["bundles"]
+            ):
+                pg["state"] = "RESCHEDULING"
+                for b in pg["bundles"]:
+                    if b.get("node_id") == node_id:
+                        b["node_id"] = None
+                self.pending_pg_queue.append(pg_id)
+                asyncio.ensure_future(self._schedule_pending_pgs())
+
+    # ------------------------------------------------------------ node table
+
+    async def handle_RegisterNode(self, req):
+        node_id = req["node_id"]
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "ip": req["ip"],
+            "raylet_port": req["raylet_port"],
+            "object_manager_port": req.get("object_manager_port", req["raylet_port"]),
+            "plasma_name": req.get("plasma_name", ""),
+            "resources_total": dict(req.get("resources", {})),
+            "resources_available": dict(req.get("resources", {})),
+            "labels": dict(req.get("labels", {})),
+            "state": "ALIVE",
+            "start_time": time.time(),
+            "is_head": bool(req.get("is_head")),
+        }
+        self.node_last_beat[node_id] = time.time()
+        self.pubsub.publish("node", {"node_id": node_id, "state": "ALIVE"})
+        # New capacity: retry pending actors/PGs.
+        asyncio.ensure_future(self._schedule_pending_actors())
+        asyncio.ensure_future(self._schedule_pending_pgs())
+        return {"ok": True}
+
+    async def handle_UnregisterNode(self, req):
+        await self._mark_node_dead(req["node_id"], "unregistered")
+        return {"ok": True}
+
+    async def handle_Heartbeat(self, req):
+        self.node_last_beat[req["node_id"]] = time.time()
+
+    async def handle_ReportResources(self, req):
+        node = self.nodes.get(req["node_id"])
+        if node is None:
+            return
+        node["resources_available"] = req["available"]
+        node["resources_total"] = req["total"]
+        self.node_last_beat[req["node_id"]] = time.time()
+        if self.pending_actor_queue:
+            asyncio.ensure_future(self._schedule_pending_actors())
+        if self.pending_pg_queue:
+            asyncio.ensure_future(self._schedule_pending_pgs())
+
+    async def handle_GetAllNodeInfo(self, req):
+        return {"nodes": list(self.nodes.values())}
+
+    async def handle_GetClusterResources(self, req):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for nid in self.alive_nodes():
+            n = self.nodes[nid]
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n["resources_available"].items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def handle_GetInternalConfig(self, req):
+        return {"config": RTPU_CONFIG.dump(), "session_dir": self.session_dir}
+
+    # --------------------------------------------------------------------- kv
+
+    async def handle_KVPut(self, req):
+        added = self.kv.put(req["ns"], req["key"], req["value"], req.get("overwrite", True))
+        return {"added": added}
+
+    async def handle_KVGet(self, req):
+        return {"value": self.kv.get(req["ns"], req["key"])}
+
+    async def handle_KVDel(self, req):
+        return {"deleted": self.kv.delete(req["ns"], req["key"])}
+
+    async def handle_KVKeys(self, req):
+        return {"keys": self.kv.keys(req["ns"], req.get("prefix", b""))}
+
+    async def handle_KVExists(self, req):
+        return {"exists": self.kv.exists(req["ns"], req["key"])}
+
+    # ------------------------------------------------------------------ pubsub
+
+    async def handle_Subscribe(self, req):
+        self.pubsub.subscribe(req["sub_id"], req["channel"])
+        return {"ok": True}
+
+    async def handle_Unsubscribe(self, req):
+        self.pubsub.unsubscribe(req["sub_id"], req.get("channel"))
+        return {"ok": True}
+
+    async def handle_PubsubPoll(self, req):
+        timeout = min(req.get("timeout", 30.0), RTPU_CONFIG.pubsub_poll_timeout_s)
+        batch = await self.pubsub.poll(req["sub_id"], timeout)
+        return {"batch": batch}
+
+    async def handle_Publish(self, req):
+        self.pubsub.publish(req["channel"], req["message"])
+        return {"ok": True}
+
+    # -------------------------------------------------------------------- jobs
+
+    async def handle_AddJob(self, req):
+        self.jobs[req["job_id"]] = {
+            "job_id": req["job_id"],
+            "driver_addr": req.get("driver_addr"),
+            "start_time": time.time(),
+            "end_time": None,
+            "state": "RUNNING",
+            "entrypoint": req.get("entrypoint", ""),
+            "metadata": req.get("metadata", {}),
+        }
+        self.pubsub.publish("job", {"job_id": req["job_id"], "state": "RUNNING"})
+        return {"ok": True}
+
+    async def handle_MarkJobFinished(self, req):
+        job = self.jobs.get(req["job_id"])
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        self.pubsub.publish("job", {"job_id": req["job_id"], "state": "FINISHED"})
+        # Tell raylets to reap this job's workers.
+        for nid in self.alive_nodes():
+            try:
+                client = await self._raylet_client(nid)
+                await client.notify("JobFinished", {"job_id": req["job_id"]})
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def handle_GetAllJobInfo(self, req):
+        return {"jobs": list(self.jobs.values())}
+
+    # ------------------------------------------------------------------ actors
+
+    async def handle_RegisterActor(self, req):
+        """Register + asynchronously schedule an actor creation.
+
+        req: {actor_id, creation_spec(task spec dict), name, ray_namespace,
+              max_restarts, detached}
+        """
+        actor_id = req["actor_id"]
+        name = req.get("name") or ""
+        ns = req.get("namespace") or ""
+        if name:
+            if (ns, name) in self.named_actors:
+                existing = self.named_actors[(ns, name)]
+                if self.actors.get(existing, {}).get("state") != DEAD:
+                    raise ValueError(f"actor name '{name}' already taken")
+            self.named_actors[(ns, name)] = actor_id
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "state": PENDING_CREATION,
+            "creation_spec": req["creation_spec"],
+            "name": name,
+            "namespace": ns,
+            "max_restarts": req.get("max_restarts", 0),
+            "num_restarts": 0,
+            "detached": req.get("detached", False),
+            "node_id": None,
+            "worker_id": None,
+            "addr": None,
+            "job_id": req["creation_spec"]["job_id"],
+            "death_cause": "",
+            "start_time": time.time(),
+        }
+        self.pending_actor_queue.append(actor_id)
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return {"ok": True}
+
+    def _pick_node(self, resources: Dict[str, float], strategy: dict) -> Optional[bytes]:
+        """Hybrid placement for actors/PG bundles at the GCS level."""
+        candidates = []
+        soft_affinity = None
+        for nid in self.alive_nodes():
+            n = self.nodes[nid]
+            if strategy.get("type") == "node_affinity":
+                if nid != strategy["node_id"]:
+                    continue
+            avail = n["resources_available"]
+            total = n["resources_total"]
+            if all(avail.get(k, 0) >= v for k, v in resources.items()) and all(
+                total.get(k, 0) >= v for k, v in resources.items()
+            ):
+                used = sum(
+                    1 - avail.get(k, 0) / total[k] for k in total if total[k] > 0
+                )
+                candidates.append((used, nid))
+        if not candidates:
+            if strategy.get("type") == "node_affinity" and strategy.get("soft"):
+                return self._pick_node(resources, {})
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        if strategy.get("type") == "spread":
+            return candidates[0][1]  # least utilized
+        # default: pack — most utilized feasible node below threshold, else least
+        packed = [c for c in candidates if c[0] <= RTPU_CONFIG.scheduler_spread_threshold]
+        if packed:
+            return packed[-1][1]
+        return candidates[0][1]
+
+    async def _schedule_pending_actors(self):
+        queue, self.pending_actor_queue = self.pending_actor_queue, []
+        for actor_id in queue:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
+                continue
+            ok = await self._try_create_actor(actor_id, rec)
+            if not ok and self.actors.get(actor_id, {}).get("state") in (
+                PENDING_CREATION,
+                RESTARTING,
+            ):
+                self.pending_actor_queue.append(actor_id)
+
+    async def _try_create_actor(self, actor_id: bytes, rec: dict) -> bool:
+        spec = rec["creation_spec"]
+        strategy = spec.get("strategy", {})
+        if strategy.get("type") == "placement_group":
+            pg = self.placement_groups.get(strategy["pg_id"])
+            if pg is None or pg["state"] != "CREATED":
+                return False
+            bundle = pg["bundles"][strategy.get("bundle_index") or 0]
+            node_id = bundle["node_id"]
+        else:
+            node_id = self._pick_node(spec["resources"], strategy)
+        if node_id is None:
+            return False
+        try:
+            raylet = await self._raylet_client(node_id)
+            reply = await raylet.call(
+                "LeaseWorkerForActor",
+                {
+                    "actor_id": actor_id,
+                    "job_id": spec["job_id"],
+                    "resources": spec["resources"],
+                    "strategy": strategy,
+                    "runtime_env": spec.get("runtime_env", {}),
+                },
+                timeout=RTPU_CONFIG.worker_startup_timeout_s,
+            )
+        except Exception as e:
+            logger.warning("actor lease on %s failed: %s", node_id.hex(), e)
+            return False
+        if not reply.get("granted"):
+            return False
+        worker_addr = tuple(reply["worker_addr"])
+        worker_id = reply["worker_id"]
+        try:
+            worker = await self.pool.get(*worker_addr)
+            result = await worker.call(
+                "CreateActor", {"spec": spec, "actor_id": actor_id},
+                timeout=RTPU_CONFIG.worker_startup_timeout_s,
+            )
+        except Exception as e:
+            logger.warning("actor creation on %s failed: %s", node_id.hex(), e)
+            return False
+        if not result.get("ok"):
+            # Creation raised in __init__: actor is DEAD with the error recorded.
+            rec["state"] = DEAD
+            rec["death_cause"] = result.get("error", "creation failed")
+            self._publish_actor(actor_id, rec)
+            return True
+        rec.update(
+            state=ALIVE, node_id=node_id, worker_id=worker_id, addr=list(worker_addr)
+        )
+        self._publish_actor(actor_id, rec)
+        return True
+
+    def _publish_actor(self, actor_id: bytes, rec: dict):
+        msg = {
+            "actor_id": actor_id,
+            "state": rec["state"],
+            "addr": rec["addr"],
+            "num_restarts": rec["num_restarts"],
+            "death_cause": rec.get("death_cause", ""),
+        }
+        self.pubsub.publish("actor", msg)
+        self.pubsub.publish(f"actor:{actor_id.hex()}", msg)
+
+    async def _on_actor_worker_lost(self, actor_id: bytes, reason: str):
+        rec = self.actors.get(actor_id)
+        if rec is None or rec["state"] == DEAD:
+            return
+        if rec["num_restarts"] < rec["max_restarts"] or rec["max_restarts"] < 0:
+            rec["num_restarts"] += 1
+            rec["state"] = RESTARTING
+            rec["addr"] = None
+            self._publish_actor(actor_id, rec)
+            self.pending_actor_queue.append(actor_id)
+            asyncio.ensure_future(self._schedule_pending_actors())
+        else:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            rec["addr"] = None
+            self._publish_actor(actor_id, rec)
+
+    async def handle_ReportWorkerDeath(self, req):
+        """Raylet tells us a worker process exited; may host an actor."""
+        actor_id = req.get("actor_id")
+        self._worker_failures.append(
+            {"worker_id": req.get("worker_id"), "node_id": req.get("node_id"),
+             "time": time.time(), "reason": req.get("reason", "")}
+        )
+        if actor_id:
+            await self._on_actor_worker_lost(actor_id, req.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def handle_GetActorInfo(self, req):
+        rec = self.actors.get(req["actor_id"])
+        if rec is None:
+            return {"found": False}
+        out = {k: v for k, v in rec.items() if k != "creation_spec"}
+        return {"found": True, "actor": out}
+
+    async def handle_GetActorByName(self, req):
+        actor_id = self.named_actors.get((req.get("namespace") or "", req["name"]))
+        if actor_id is None:
+            return {"found": False}
+        return await self.handle_GetActorInfo({"actor_id": actor_id})
+
+    async def handle_ListActors(self, req):
+        out = []
+        for rec in self.actors.values():
+            out.append({k: v for k, v in rec.items() if k != "creation_spec"})
+        return {"actors": out}
+
+    async def handle_KillActor(self, req):
+        actor_id = req["actor_id"]
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return {"ok": False}
+        no_restart = req.get("no_restart", True)
+        if no_restart:
+            rec["max_restarts"] = rec["num_restarts"]  # exhaust restarts
+        if rec.get("addr"):
+            try:
+                worker = await self.pool.get(*rec["addr"])
+                await worker.notify("KillActor", {"actor_id": actor_id})
+            except Exception:
+                pass
+        if no_restart:
+            rec["state"] = DEAD
+            rec["death_cause"] = "killed via kill()"
+            name = rec.get("name")
+            if name:
+                self.named_actors.pop((rec.get("namespace", ""), name), None)
+            self._publish_actor(actor_id, rec)
+        return {"ok": True}
+
+    # -------------------------------------------------------- placement groups
+
+    async def handle_CreatePlacementGroup(self, req):
+        pg_id = req["pg_id"]
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id,
+            "name": req.get("name", ""),
+            "strategy": req.get("strategy", "PACK"),
+            "bundles": [
+                {"index": i, "resources": dict(b), "node_id": None}
+                for i, b in enumerate(req["bundles"])
+            ],
+            "state": "PENDING",
+            "job_id": req.get("job_id"),
+            "ready_event": None,
+        }
+        self.pending_pg_queue.append(pg_id)
+        asyncio.ensure_future(self._schedule_pending_pgs())
+        return {"ok": True}
+
+    def _select_pg_nodes(self, pg) -> Optional[List[bytes]]:
+        """Choose a node per bundle according to the PG strategy.
+
+        Strategies per reference common.proto:939: PACK, SPREAD, STRICT_PACK,
+        STRICT_SPREAD.
+        """
+        strategy = pg["strategy"]
+        bundles = pg["bundles"]
+        nodes = {
+            nid: dict(self.nodes[nid]["resources_available"])
+            for nid in self.alive_nodes()
+        }
+
+        def fits(avail, res):
+            return all(avail.get(k, 0) >= v for k, v in res.items())
+
+        def take(avail, res):
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+
+        if strategy == "STRICT_PACK":
+            for nid, avail in sorted(nodes.items()):
+                trial = dict(avail)
+                if all(self._fits_take(trial, b["resources"]) for b in bundles):
+                    return [nid] * len(bundles)
+            return None
+
+        placement: List[Optional[bytes]] = [None] * len(bundles)
+        used_nodes: List[bytes] = []
+        # Order node preference: pack→most loaded first reuse; spread→rotate.
+        order = sorted(nodes.keys())
+        for i, b in enumerate(bundles):
+            chosen = None
+            if strategy in ("SPREAD", "STRICT_SPREAD"):
+                pref = [n for n in order if n not in used_nodes] + (
+                    [] if strategy == "STRICT_SPREAD" else [n for n in order if n in used_nodes]
+                )
+            else:  # PACK: prefer already-used nodes
+                pref = [n for n in order if n in used_nodes] + [
+                    n for n in order if n not in used_nodes
+                ]
+            for nid in pref:
+                if fits(nodes[nid], b["resources"]):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return None
+            take(nodes[chosen], b["resources"])
+            placement[i] = chosen
+            if chosen not in used_nodes:
+                used_nodes.append(chosen)
+        return placement
+
+    @staticmethod
+    def _fits_take(avail, res):
+        if all(avail.get(k, 0) >= v for k, v in res.items()):
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+            return True
+        return False
+
+    async def _schedule_pending_pgs(self):
+        queue, self.pending_pg_queue = self.pending_pg_queue, []
+        for pg_id in queue:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg["state"] in ("CREATED", "REMOVED"):
+                continue
+            ok = await self._try_create_pg(pg_id, pg)
+            if not ok and self.placement_groups.get(pg_id, {}).get("state") in (
+                "PENDING",
+                "RESCHEDULING",
+            ):
+                self.pending_pg_queue.append(pg_id)
+
+    async def _try_create_pg(self, pg_id: bytes, pg) -> bool:
+        placement = self._select_pg_nodes(pg)
+        if placement is None:
+            return False
+        # Phase 1: prepare (reserve) on each raylet
+        # (2PC like reference gcs_placement_group_scheduler.h).
+        prepared: List[Tuple[bytes, int]] = []
+        ok = True
+        for bundle, node_id in zip(pg["bundles"], placement):
+            try:
+                raylet = await self._raylet_client(node_id)
+                r = await raylet.call(
+                    "PrepareBundle",
+                    {"pg_id": pg_id, "bundle_index": bundle["index"],
+                     "resources": bundle["resources"]},
+                    timeout=10,
+                )
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append((node_id, bundle["index"]))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for node_id, idx in prepared:
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    await raylet.notify("CancelBundle", {"pg_id": pg_id, "bundle_index": idx})
+                except Exception:
+                    pass
+            return False
+        # Phase 2: commit
+        for bundle, node_id in zip(pg["bundles"], placement):
+            raylet = await self._raylet_client(node_id)
+            await raylet.call(
+                "CommitBundle", {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                timeout=10,
+            )
+            bundle["node_id"] = node_id
+        pg["state"] = "CREATED"
+        self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
+        # PG capacity consumed: retry pending actors that wait on it.
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return True
+
+    async def handle_GetPlacementGroup(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, "pg": {k: v for k, v in pg.items() if k != "ready_event"}}
+
+    async def handle_ListPlacementGroups(self, req):
+        return {
+            "pgs": [
+                {k: v for k, v in pg.items() if k != "ready_event"}
+                for pg in self.placement_groups.values()
+            ]
+        }
+
+    async def handle_WaitPlacementGroupReady(self, req):
+        pg_id = req["pg_id"]
+        timeout = req.get("timeout", 60.0)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                raise ValueError("placement group removed")
+            if pg["state"] == "CREATED":
+                return {"ready": True}
+            await asyncio.sleep(0.02)
+        return {"ready": False}
+
+    async def handle_RemovePlacementGroup(self, req):
+        pg_id = req["pg_id"]
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return {"ok": True}
+        for bundle in pg["bundles"]:
+            node_id = bundle.get("node_id")
+            if node_id and node_id in self.nodes:
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    await raylet.notify(
+                        "ReturnBundle", {"pg_id": pg_id, "bundle_index": bundle["index"]}
+                    )
+                except Exception:
+                    pass
+        pg["state"] = "REMOVED"
+        self.pubsub.publish("pg", {"pg_id": pg_id, "state": "REMOVED"})
+        return {"ok": True}
+
+    # -------------------------------------------------------------- task events
+
+    async def handle_AddTaskEvents(self, req):
+        self.task_events.extend(req["events"])
+        overflow = len(self.task_events) - 100_000
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return {"ok": True}
+
+    async def handle_GetTaskEvents(self, req):
+        job_id = req.get("job_id")
+        out = [
+            e
+            for e in self.task_events
+            if job_id is None or e.get("job_id") == job_id
+        ]
+        limit = req.get("limit", 10_000)
+        return {"events": out[-limit:]}
+
+    async def handle_GetWorkerFailures(self, req):
+        return {"failures": self._worker_failures[-req.get("limit", 1000):]}
+
+    async def handle_Ping(self, req):
+        return {"ok": True, "uptime": time.time() - self.start_time}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", default="")
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    async def run():
+        server = GcsServer(args.host, args.session_dir)
+        port = await server.start(args.port)
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            os.replace(tmp, args.port_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""In-process memory store for inline objects owned by this worker.
+
+Counterpart of the reference's CoreWorkerMemoryStore
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h):
+small task returns and pending-object placeholders live here; `get` waiters
+block on per-object asyncio events on the worker's IO loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class _Pending:
+    __slots__ = ("event",)
+
+    def __init__(self):
+        self.event = asyncio.Event()
+
+
+class InPlasma:
+    """Placeholder value: the object's data lives in plasma, not in memory."""
+
+    __slots__ = ("size", "locations")
+
+    def __init__(self, size: int, locations=None):
+        self.size = size
+        # set of node_id bytes where a copy exists (owner-maintained directory)
+        self.locations = set(locations or [])
+
+
+class MemoryStore:
+    """Must only be touched from the IO loop thread."""
+
+    def __init__(self):
+        self._store: Dict[ObjectID, Any] = {}
+        self._pending: Dict[ObjectID, _Pending] = {}
+
+    def put_pending(self, object_id: ObjectID):
+        if object_id not in self._store and object_id not in self._pending:
+            self._pending[object_id] = _Pending()
+
+    def put(self, object_id: ObjectID, value: Any):
+        self._store[object_id] = value
+        p = self._pending.pop(object_id, None)
+        if p is not None:
+            p.event.set()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._store
+
+    def get_if_exists(self, object_id: ObjectID):
+        return self._store.get(object_id)
+
+    def is_pending(self, object_id: ObjectID) -> bool:
+        return object_id in self._pending
+
+    async def wait_ready(self, object_id: ObjectID, timeout: Optional[float] = None):
+        """Wait until a value (or plasma placeholder) is set. Returns True if ready."""
+        if object_id in self._store:
+            return True
+        p = self._pending.get(object_id)
+        if p is None:
+            # Not pending and not present: either never created here or already freed.
+            return object_id in self._store
+        try:
+            await asyncio.wait_for(p.event.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def free(self, object_id: ObjectID):
+        self._store.pop(object_id, None)
+        p = self._pending.pop(object_id, None)
+        if p is not None:
+            p.event.set()
+
+    def fail_pending(self, object_id: ObjectID, error: Exception):
+        """Resolve a pending object to an error value (task failure, etc.)."""
+        self.put(object_id, error)
+
+    def size(self) -> int:
+        return len(self._store)

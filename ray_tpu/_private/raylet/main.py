@@ -1,0 +1,644 @@
+"""Raylet — the per-node agent: worker pool, leases, local scheduling, object plane.
+
+Counterpart of the reference's raylet/NodeManager
+(reference: src/ray/raylet/node_manager.h:119, main.cc:123). One asyncio loop
+runs: the lease protocol (RequestWorkerLease/ReturnWorker — reference:
+node_manager.cc:1794), placement-group bundle 2PC
+(reference: placement_group_resource_manager.h), the node-to-node object
+manager (pull + chunked fetch — reference: object_manager/object_manager.cc),
+worker lifecycle (spawn/reap, death reports to GCS), heartbeats and resource
+reports. The plasma segment for the node is created here and shared with every
+worker on the host.
+
+Scheduling is the reference's two-level design: owners cache leases per
+scheduling key and push tasks worker-to-worker; the raylet only places
+*leases*, locally when it can, spilling to a peer picked from the
+GCS-maintained cluster view otherwise (hybrid pack-then-spread policy,
+reference: raylet/scheduling/policy/hybrid_scheduling_policy.cc).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._native.plasma import PlasmaClient
+from ray_tpu._private import accelerators
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.gcs.client import GcsAioClient
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.raylet.resources import ResourceSet
+from ray_tpu._private.raylet.worker_pool import WorkerPool
+from ray_tpu._private.rpc import ClientPool, RpcServer
+
+logger = logging.getLogger("ray_tpu.raylet")
+
+
+class NodeManager:
+    def __init__(
+        self,
+        node_id: NodeID,
+        host: str,
+        gcs_address: str,
+        resources: Dict[str, float],
+        labels: Dict[str, str],
+        session_dir: str,
+        is_head: bool = False,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.node_id = node_id
+        self.host = host
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.is_head = is_head
+        self.server = RpcServer(host)
+        gcs_host, gcs_port = gcs_address.rsplit(":", 1)
+        self.gcs = GcsAioClient(gcs_host, int(gcs_port))
+        self.pool = ClientPool()
+
+        self.total = ResourceSet(resources)
+        self.available = ResourceSet(resources)
+        self.labels = labels
+        self._resources_dirty = True
+
+        self.plasma_name = f"/rtpu_plasma_{node_id.hex()[:12]}"
+        self.plasma = PlasmaClient(
+            self.plasma_name,
+            capacity=object_store_memory or RTPU_CONFIG.object_store_memory,
+            create=True,
+        )
+
+        self.worker_pool: Optional[WorkerPool] = None  # needs our port first
+
+        # lease_id -> {"worker_id", "resources": ResourceSet, "bundle": key|None}
+        self.leases: Dict[bytes, dict] = {}
+        self._lease_seq = 0
+        # queued lease requests waiting for local resources
+        self._lease_waiters: List[dict] = []
+        # (pg_id, bundle_index) -> {"reserved": ResourceSet, "available": ResourceSet,
+        #                            "committed": bool}
+        self.bundles: Dict[Tuple[bytes, int], dict] = {}
+        # worker_id -> actor_id for dedicated actor workers
+        self._actor_workers: Dict[bytes, bytes] = {}
+        # cluster view: node_id -> info (from GCS)
+        self.cluster_view: Dict[bytes, dict] = {}
+        # object pulls in flight: object_id bytes -> asyncio.Event
+        self._pulls: Dict[bytes, asyncio.Event] = {}
+        # pinned primary copies: object_id bytes -> memoryview
+        self._pinned: Dict[bytes, memoryview] = {}
+        self._bg = []
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self, port: int = 0) -> int:
+        self.server.register_all(self)
+        port = await self.server.start(port)
+        self.port = port
+        self.worker_pool = WorkerPool(
+            self.node_id.binary(),
+            (self.host, port),
+            self.gcs_address,
+            self.plasma_name,
+            self.session_dir,
+        )
+        await self.gcs.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id.binary(),
+                "ip": self.host,
+                "raylet_port": port,
+                "plasma_name": self.plasma_name,
+                "resources": self.total.to_dict(),
+                "labels": self.labels,
+                "is_head": self.is_head,
+            },
+        )
+        self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._bg.append(asyncio.ensure_future(self._reaper_loop()))
+        self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
+        logger.info(
+            "raylet %s on %s:%s resources=%s",
+            self.node_id.hex()[:12], self.host, port, self.total.to_dict(),
+        )
+        return port
+
+    async def _heartbeat_loop(self):
+        period = RTPU_CONFIG.health_check_period_ms / 1000.0
+        report_period = RTPU_CONFIG.resource_report_period_ms / 1000.0
+        last_report = 0.0
+        while True:
+            try:
+                await self.gcs.notify("Heartbeat", {"node_id": self.node_id.binary()})
+                now = time.time()
+                if self._resources_dirty or now - last_report > report_period * 4:
+                    await self.gcs.notify(
+                        "ReportResources",
+                        {
+                            "node_id": self.node_id.binary(),
+                            "available": self.available.to_dict(),
+                            "total": self.total.to_dict(),
+                        },
+                    )
+                    self._resources_dirty = False
+                    last_report = now
+            except Exception:
+                pass
+            await asyncio.sleep(min(period, report_period))
+
+    async def _cluster_view_loop(self):
+        while True:
+            try:
+                nodes = await self.gcs.get_all_node_info()
+                self.cluster_view = {n["node_id"]: n for n in nodes if n["state"] == "ALIVE"}
+            except Exception:
+                pass
+            await asyncio.sleep(0.5)
+
+    async def _reaper_loop(self):
+        while True:
+            await asyncio.sleep(0.25)
+            try:
+                dead = self.worker_pool.reap_dead()
+                for h in dead:
+                    await self._on_worker_death(h)
+                self.worker_pool.reap_idle()
+            except Exception:
+                logger.exception("reaper error")
+
+    async def _on_worker_death(self, handle):
+        # release any leases held by this worker
+        for lease_id, lease in list(self.leases.items()):
+            if lease["worker_id"] == handle.worker_id:
+                self._release_lease(lease_id)
+        actor_id = self._actor_workers.pop(handle.worker_id, None)
+        rc = handle.proc.returncode
+        await self.gcs.notify(
+            "ReportWorkerDeath",
+            {
+                "worker_id": handle.worker_id,
+                "node_id": self.node_id.binary(),
+                "actor_id": actor_id,
+                "reason": f"exit code {rc}",
+            },
+        )
+
+    # ------------------------------------------------------ resource helpers
+
+    def _pool_for(self, strategy: dict):
+        """Returns (acquire_set, bundle_key) — PG tasks draw from their bundle."""
+        if strategy.get("type") == "placement_group":
+            key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
+            bundle = self.bundles.get(key)
+            if bundle is None or not bundle["committed"]:
+                return None, key
+            return bundle["available"], key
+        return self.available, None
+
+    def _try_acquire(self, resources: Dict[str, float], strategy: dict):
+        demand = ResourceSet(resources)
+        pool, bundle_key = self._pool_for(strategy)
+        if pool is None:
+            return None
+        if pool.acquire(demand):
+            self._resources_dirty = True
+            return {"demand": demand, "bundle": bundle_key}
+        return None
+
+    def _release_lease(self, lease_id: bytes):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        if lease["bundle"] is not None:
+            bundle = self.bundles.get(lease["bundle"])
+            if bundle is not None:
+                bundle["available"].release(lease["grant"]["demand"])
+        else:
+            self.available.release(lease["grant"]["demand"])
+        self._resources_dirty = True
+        self._kick_waiters()
+
+    def _kick_waiters(self):
+        if self._lease_waiters:
+            waiters, self._lease_waiters = self._lease_waiters, []
+            for w in waiters:
+                w["event"].set()
+
+    def _local_feasible(self, resources: Dict[str, float], strategy: dict) -> bool:
+        if strategy.get("type") == "placement_group":
+            key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
+            bundle = self.bundles.get(key)
+            return bundle is not None and bundle["committed"]
+        return self.total.fits(ResourceSet(resources))
+
+    def _pick_spill_node(
+        self, resources: Dict[str, float], strategy: dict, require_available: bool
+    ) -> Optional[dict]:
+        """Hybrid policy over the GCS cluster view; returns peer node info or None."""
+        demand = ResourceSet(resources)
+        best = None
+        best_score = None
+        for nid, info in self.cluster_view.items():
+            if nid == self.node_id.binary():
+                continue
+            total = ResourceSet(info.get("resources_total", {}))
+            avail = ResourceSet(info.get("resources_available", {}))
+            if not total.fits(demand):
+                continue
+            if require_available and not avail.fits(demand):
+                continue
+            td, ad = total.to_dict(), avail.to_dict()
+            used = sum(1 - ad.get(k, 0) / v for k, v in td.items() if v > 0)
+            if strategy.get("type") == "spread":
+                score = used  # least loaded wins
+            else:
+                score = -used  # pack: most loaded feasible wins
+            if best_score is None or score < best_score:
+                best, best_score = info, score
+        return best
+
+    # ------------------------------------------------------------ worker RPC
+
+    async def handle_RegisterWorker(self, req):
+        addr = (self.host, req["port"])
+        token = req.get("startup_token", -1)
+        if token >= 0:
+            self.worker_pool.on_worker_registered(token, req["worker_id"], addr)
+        return {
+            "node_id": self.node_id.binary(),
+            "plasma_name": self.plasma_name,
+            "gcs_address": self.gcs_address,
+        }
+
+    async def handle_RequestWorkerLease(self, req):
+        """Grant a local worker, tell the caller to spill, or queue."""
+        resources = req.get("resources", {})
+        strategy = req.get("strategy", {})
+        job_id = req["job_id"]
+        deadline = time.time() + RTPU_CONFIG.worker_lease_timeout_ms / 1000.0
+
+        affinity = strategy.get("type") == "node_affinity"
+        if affinity and strategy.get("node_id") != self.node_id.binary():
+            target = self.cluster_view.get(strategy.get("node_id"))
+            if target is None:
+                if strategy.get("soft"):
+                    strategy = {}
+                else:
+                    return {"error": "affinity node not alive"}
+            else:
+                return {"spill": {"ip": target["ip"], "port": target["raylet_port"],
+                                   "node_id": target["node_id"]}}
+
+        while True:
+            grant = self._try_acquire(resources, strategy)
+            if grant is not None:
+                handle = await self.worker_pool.pop_worker(job_id)
+                if handle is None:
+                    # worker failed to start; release and retry
+                    pool, _ = self._pool_for(strategy)
+                    pool.release(grant["demand"])
+                    return {"error": "worker startup failed"}
+                self._lease_seq += 1
+                lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
+                handle.lease_id = lease_id
+                self.leases[lease_id] = {
+                    "worker_id": handle.worker_id,
+                    "grant": grant,
+                    "bundle": grant["bundle"],
+                }
+                return {
+                    "granted": True,
+                    "worker_addr": list(handle.addr),
+                    "worker_id": handle.worker_id,
+                    "lease_id": lease_id,
+                }
+
+            # Can't grant now. Spread tasks and locally-infeasible tasks spill.
+            spill_now = self._pick_spill_node(resources, strategy, require_available=True)
+            local_ok = self._local_feasible(resources, strategy)
+            if strategy.get("type") == "spread" and spill_now is not None:
+                # crude spread: alternate between local queue and remote
+                return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
+                                   "node_id": spill_now["node_id"]}}
+            if not local_ok:
+                if spill_now is not None:
+                    return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
+                                       "node_id": spill_now["node_id"]}}
+                spill_any = self._pick_spill_node(resources, strategy, require_available=False)
+                if spill_any is not None:
+                    return {"spill": {"ip": spill_any["ip"], "port": spill_any["raylet_port"],
+                                       "node_id": spill_any["node_id"]}}
+                if strategy.get("type") == "placement_group":
+                    return {"error": "placement group bundle not on this node"}
+                return {"error": f"infeasible resource request {resources}"}
+            if spill_now is not None:
+                return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
+                                   "node_id": spill_now["node_id"]}}
+            # queue locally until resources free up
+            waiter = {"event": asyncio.Event()}
+            self._lease_waiters.append(waiter)
+            timeout = deadline - time.time()
+            if timeout <= 0:
+                return {"retry": True}
+            try:
+                await asyncio.wait_for(waiter["event"].wait(), timeout)
+            except asyncio.TimeoutError:
+                if waiter in self._lease_waiters:
+                    self._lease_waiters.remove(waiter)
+                return {"retry": True}
+
+    async def handle_ReturnWorker(self, req):
+        lease = self.leases.get(req["lease_id"])
+        if lease is not None:
+            self._release_lease(req["lease_id"])
+            handle = self.worker_pool.workers.get(lease["worker_id"])
+            if handle is not None:
+                if req.get("kill"):
+                    self.worker_pool.kill_worker(handle)
+                else:
+                    self.worker_pool.push_idle(handle)
+        return {"ok": True}
+
+    async def handle_GetNodeInfo(self, req):
+        return {
+            "node_id": self.node_id.binary(),
+            "ip": self.host,
+            "port": self.port,
+            "plasma_name": self.plasma_name,
+            "resources_total": self.total.to_dict(),
+            "resources_available": self.available.to_dict(),
+            "labels": self.labels,
+            "num_workers": len(self.worker_pool.workers),
+            "object_store": self.plasma.stats(),
+        }
+
+    # --------------------------------------------------------------- actors
+
+    async def handle_LeaseWorkerForActor(self, req):
+        """GCS asks us to supply a dedicated worker for an actor."""
+        grant = self._try_acquire(req["resources"], req.get("strategy", {}))
+        if grant is None:
+            return {"granted": False}
+        env = {}
+        num_tpu = req["resources"].get("TPU", 0)
+        if num_tpu and num_tpu == int(num_tpu):
+            env.update(accelerators.visible_chip_env(range(int(num_tpu))))
+        handle = await self.worker_pool.pop_worker(req["job_id"], env or None)
+        if handle is None:
+            pool, _ = self._pool_for(req.get("strategy", {}))
+            pool.release(grant["demand"])
+            return {"granted": False}
+        self._lease_seq += 1
+        lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
+        handle.lease_id = lease_id
+        handle.actor_id = req["actor_id"]
+        self.leases[lease_id] = {
+            "worker_id": handle.worker_id,
+            "grant": grant,
+            "bundle": grant["bundle"],
+        }
+        self._actor_workers[handle.worker_id] = req["actor_id"]
+        return {
+            "granted": True,
+            "worker_addr": list(handle.addr),
+            "worker_id": handle.worker_id,
+            "lease_id": lease_id,
+        }
+
+    async def handle_KillWorker(self, req):
+        handle = self.worker_pool.workers.get(req["worker_id"])
+        if handle is not None:
+            self.worker_pool.kill_worker(handle)
+            await self._on_worker_death(handle)
+        return {"ok": True}
+
+    async def handle_JobFinished(self, req):
+        self.worker_pool.kill_job_workers(req["job_id"])
+
+    # ------------------------------------------------------ placement groups
+
+    async def handle_PrepareBundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        if key in self.bundles:
+            return {"ok": True}
+        demand = ResourceSet(req["resources"])
+        if not self.available.acquire(demand):
+            return {"ok": False}
+        self._resources_dirty = True
+        self.bundles[key] = {
+            "reserved": demand,
+            "available": demand.copy(),
+            "committed": False,
+        }
+        return {"ok": True}
+
+    async def handle_CommitBundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        bundle = self.bundles.get(key)
+        if bundle is None:
+            return {"ok": False}
+        bundle["committed"] = True
+        return {"ok": True}
+
+    async def handle_CancelBundle(self, req):
+        await self._return_bundle(req)
+
+    async def handle_ReturnBundle(self, req):
+        await self._return_bundle(req)
+
+    async def _return_bundle(self, req):
+        key = (req["pg_id"], req["bundle_index"])
+        bundle = self.bundles.pop(key, None)
+        if bundle is not None:
+            self.available.release(bundle["reserved"])
+            self._resources_dirty = True
+            self._kick_waiters()
+
+    # --------------------------------------------------------- object plane
+
+    async def handle_PinObject(self, req):
+        """Hold the primary copy of an owned object against LRU eviction."""
+        oid = req["object_id"]
+        if oid not in self._pinned:
+            view = self.plasma.get(oid)
+            if view is not None:
+                self._pinned[oid] = view
+
+    async def handle_FreeObjects(self, req):
+        for oid in req["ids"]:
+            view = self._pinned.pop(oid, None)
+            if view is not None:
+                try:
+                    view.release()
+                except Exception:
+                    pass
+                self.plasma.release(oid)
+            self.plasma.delete(oid)
+
+    async def handle_FetchObjectInfo(self, req):
+        view = self.plasma.get(req["object_id"])
+        if view is None:
+            return {"found": False}
+        size = view.nbytes
+        view.release()
+        self.plasma.release(req["object_id"])
+        return {"found": True, "size": size}
+
+    async def handle_FetchChunk(self, req):
+        view = self.plasma.get(req["object_id"])
+        if view is None:
+            return {"found": False}
+        off, size = req["offset"], req["size"]
+        data = bytes(view[off : off + size])
+        view.release()
+        self.plasma.release(req["object_id"])
+        return {"found": True, "data": data}
+
+    async def handle_PullObject(self, req):
+        """Make the object local; replies once it is sealed in local plasma.
+
+        Pull-based like the reference's PullManager (reference:
+        object_manager/pull_manager.h:92); chunked fetch from one holder.
+        """
+        oid = req["object_id"]
+        if self.plasma.contains(oid):
+            return {"ok": True}
+        inflight = self._pulls.get(oid)
+        if inflight is not None:
+            await inflight.wait()
+            return {"ok": self.plasma.contains(oid)}
+        event = asyncio.Event()
+        self._pulls[oid] = event
+        try:
+            ok = await self._do_pull(oid, req.get("owner_addr"))
+            return {"ok": ok}
+        finally:
+            event.set()
+            self._pulls.pop(oid, None)
+
+    async def _do_pull(self, oid: bytes, owner_addr) -> bool:
+        # 1. locations from the owner (owner-based directory, reference:
+        #    ownership_based_object_directory.h)
+        locations: List[bytes] = []
+        if owner_addr:
+            try:
+                owner = await self.pool.get(owner_addr[0], owner_addr[1])
+                status = await owner.call("GetObjectStatus", {"object_id": oid}, timeout=30)
+                locations = list(status.get("locations", []))
+            except Exception as e:
+                logger.warning("pull %s: owner unreachable: %s", oid.hex()[:12], e)
+                return False
+        for loc in locations:
+            if loc == self.node_id.binary():
+                continue
+            info = self.cluster_view.get(loc)
+            if info is None:
+                continue
+            try:
+                peer = await self.pool.get(info["ip"], info["raylet_port"])
+                meta = await peer.call("FetchObjectInfo", {"object_id": oid}, timeout=30)
+                if not meta.get("found"):
+                    continue
+                size = meta["size"]
+                try:
+                    dest = self.plasma.create(oid, size)
+                except FileExistsError:
+                    return True
+                chunk = RTPU_CONFIG.object_manager_chunk_size
+                offset = 0
+                try:
+                    while offset < size:
+                        n = min(chunk, size - offset)
+                        r = await peer.call(
+                            "FetchChunk",
+                            {"object_id": oid, "offset": offset, "size": n},
+                            timeout=60,
+                        )
+                        if not r.get("found"):
+                            raise IOError("holder evicted object mid-transfer")
+                        dest[offset : offset + n] = r["data"]
+                        offset += n
+                except Exception:
+                    dest.release()
+                    self.plasma.abort(oid)
+                    continue
+                dest.release()
+                self.plasma.seal(oid)
+                # register the new copy with the owner
+                if owner_addr:
+                    try:
+                        owner = await self.pool.get(owner_addr[0], owner_addr[1])
+                        await owner.notify(
+                            "AddObjectLocation",
+                            {"object_id": oid, "node_id": self.node_id.binary()},
+                        )
+                    except Exception:
+                        pass
+                return True
+            except Exception as e:
+                logger.warning("pull %s from %s failed: %s", oid.hex()[:12], loc.hex()[:12], e)
+        return False
+
+    async def handle_Ping(self, req):
+        return {"ok": True}
+
+    async def shutdown(self):
+        for t in self._bg:
+            t.cancel()
+        self.worker_pool.shutdown()
+        await self.server.stop()
+        self.plasma.close()
+        PlasmaClient.unlink(self.plasma_name)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", default="")
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--is-head", action="store_true")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    import json
+
+    node_id = NodeID.from_hex(args.node_id) if args.node_id else NodeID.from_random()
+    resources = json.loads(args.resources)
+    labels = json.loads(args.labels)
+    if "CPU" not in resources:
+        resources["CPU"] = float(os.cpu_count() or 1)
+    auto_res, auto_labels = accelerators.node_resources_and_labels()
+    for k, v in auto_res.items():
+        resources.setdefault(k, v)
+    for k, v in auto_labels.items():
+        labels.setdefault(k, v)
+
+    async def run():
+        nm = NodeManager(
+            node_id, args.host, args.gcs_address, resources, labels,
+            args.session_dir, is_head=args.is_head,
+            object_store_memory=args.object_store_memory or None,
+        )
+        port = await nm.start(args.port)
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            os.replace(tmp, args.port_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

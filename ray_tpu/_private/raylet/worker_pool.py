@@ -1,0 +1,187 @@
+"""Worker pool: spawns and leases Python worker processes.
+
+Counterpart of the reference's WorkerPool
+(reference: src/ray/raylet/worker_pool.h:159 — StartWorkerProcess :425,
+PrestartWorkers :359). Workers are spawned with a startup token; when the new
+process's CoreWorker connects back and registers, the token pairs it with its
+spawn record. Idle workers are cached per job and reaped after an idle
+timeout; actors get dedicated workers that live until the actor dies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RTPU_CONFIG
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    pid: int
+    proc: subprocess.Popen
+    job_id: bytes
+    addr: Tuple[str, int] = ("", 0)
+    registered: bool = False
+    startup_token: int = 0
+    # lease state
+    leased: bool = False
+    lease_id: bytes = b""
+    actor_id: bytes = b""
+    idle_since: float = field(default_factory=time.time)
+    register_event: Optional[asyncio.Event] = None
+
+
+class WorkerPool:
+    def __init__(
+        self,
+        node_id: bytes,
+        raylet_addr: Tuple[str, int],
+        gcs_addr: str,
+        plasma_name: str,
+        session_dir: str,
+        node_manager_port: int = 0,
+    ):
+        self._node_id = node_id
+        self._raylet_addr = raylet_addr
+        self._gcs_addr = gcs_addr
+        self._plasma_name = plasma_name
+        self._session_dir = session_dir
+        self._next_token = 1
+        # startup_token -> handle (not yet registered)
+        self._starting: Dict[int, WorkerHandle] = {}
+        # worker_id -> handle (registered)
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self._idle: List[WorkerHandle] = []
+
+    def start_worker(self, job_id: bytes, env_overrides=None) -> WorkerHandle:
+        token = self._next_token
+        self._next_token += 1
+        log_dir = os.path.join(self._session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        stdout = open(os.path.join(log_dir, f"worker-{token}.out"), "ab")
+        stderr = open(os.path.join(log_dir, f"worker-{token}.err"), "ab")
+        env = dict(os.environ)
+        env.update(env_overrides or {})
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+            + os.pathsep
+            + env.get("PYTHONPATH", "")
+        )
+        cmd = [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.workers.default_worker",
+            f"--raylet-host={self._raylet_addr[0]}",
+            f"--raylet-port={self._raylet_addr[1]}",
+            f"--gcs-address={self._gcs_addr}",
+            f"--node-id={self._node_id.hex()}",
+            f"--plasma-name={self._plasma_name}",
+            f"--job-id={job_id.hex()}",
+            f"--startup-token={token}",
+            f"--session-dir={self._session_dir}",
+        ]
+        proc = subprocess.Popen(
+            cmd, stdout=stdout, stderr=stderr, env=env, start_new_session=True
+        )
+        handle = WorkerHandle(
+            worker_id=b"", pid=proc.pid, proc=proc, job_id=job_id,
+            startup_token=token, register_event=asyncio.Event(),
+        )
+        self._starting[token] = handle
+        return handle
+
+    def on_worker_registered(
+        self, startup_token: int, worker_id: bytes, addr: Tuple[str, int]
+    ) -> Optional[WorkerHandle]:
+        handle = self._starting.pop(startup_token, None)
+        if handle is None:
+            return None
+        handle.worker_id = worker_id
+        handle.addr = addr
+        handle.registered = True
+        self.workers[worker_id] = handle
+        handle.register_event.set()
+        return handle
+
+    async def pop_worker(self, job_id: bytes, env_overrides=None) -> Optional[WorkerHandle]:
+        """Get an idle worker for the job or start a fresh one. Awaits registration."""
+        for i, h in enumerate(self._idle):
+            if h.job_id == job_id and h.proc.poll() is None:
+                self._idle.pop(i)
+                h.leased = True
+                return h
+        handle = self.start_worker(job_id, env_overrides)
+        try:
+            await asyncio.wait_for(
+                handle.register_event.wait(), RTPU_CONFIG.worker_startup_timeout_s
+            )
+        except asyncio.TimeoutError:
+            self.kill_worker(handle)
+            return None
+        handle.leased = True
+        return handle
+
+    def push_idle(self, handle: WorkerHandle):
+        handle.leased = False
+        handle.lease_id = b""
+        handle.idle_since = time.time()
+        if handle.proc.poll() is None:
+            self._idle.append(handle)
+
+    def kill_worker(self, handle: WorkerHandle):
+        try:
+            handle.proc.kill()
+        except Exception:
+            pass
+        self.workers.pop(handle.worker_id, None)
+        if handle in self._idle:
+            self._idle.remove(handle)
+        self._starting.pop(handle.startup_token, None)
+
+    def reap_dead(self) -> List[WorkerHandle]:
+        """Poll children; return handles of workers that exited."""
+        dead = []
+        for h in list(self.workers.values()):
+            if h.proc.poll() is not None:
+                dead.append(h)
+                self.workers.pop(h.worker_id, None)
+                if h in self._idle:
+                    self._idle.remove(h)
+        for token, h in list(self._starting.items()):
+            if h.proc.poll() is not None:
+                self._starting.pop(token)
+        return dead
+
+    def reap_idle(self):
+        now = time.time()
+        keep = []
+        for h in self._idle:
+            if now - h.idle_since > RTPU_CONFIG.idle_worker_keep_alive_s:
+                self.kill_worker(h)
+            else:
+                keep.append(h)
+        self._idle = keep
+
+    def kill_job_workers(self, job_id: bytes):
+        for h in list(self.workers.values()):
+            if h.job_id == job_id and not h.actor_id:
+                self.kill_worker(h)
+
+    def shutdown(self):
+        for h in list(self.workers.values()):
+            self.kill_worker(h)
+        for h in list(self._starting.values()):
+            try:
+                h.proc.kill()
+            except Exception:
+                pass
+
+    def num_idle(self) -> int:
+        return len(self._idle)

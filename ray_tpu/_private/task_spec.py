@@ -1,0 +1,127 @@
+"""Task specification: the wire representation of a task/actor call.
+
+Counterpart of the reference's TaskSpecification (reference:
+src/ray/common/task/task_spec.h, protobuf common.proto TaskSpec). Plain
+msgpack-able dicts; helpers here keep construction/parsing in one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+
+TASK_NORMAL = 0
+TASK_ACTOR_CREATION = 1
+TASK_ACTOR = 2
+
+
+def normalize_resources(
+    num_cpus=None, num_tpus=None, memory=None, resources=None, default_cpus=1.0
+) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    out["CPU"] = float(num_cpus) if num_cpus is not None else float(default_cpus)
+    if num_tpus:
+        out["TPU"] = float(num_tpus)
+    if memory:
+        out["memory"] = float(memory)
+    for k, v in (resources or {}).items():
+        if k in ("CPU", "TPU", "memory"):
+            raise ValueError(f"Use the dedicated option for {k}, not resources=")
+        out[k] = float(v)
+    return {k: v for k, v in out.items() if v != 0}
+
+
+def serialize_args(
+    args: tuple, kwargs: dict, inline_threshold: int
+) -> Tuple[list, List[ObjectRef], list]:
+    """Returns (wire_args, contained_refs, large_values).
+
+    Each wire arg is one of:
+      {"v": inline_payload}          — plain value (may contain nested refs)
+      {"ref": [id_bytes, owner]}     — top-level ObjectRef arg (resolved by executor)
+    Values larger than inline_threshold are returned in large_values as
+    (position_key, value) for the caller to put() and replace with a ref.
+    """
+    wire = []
+    refs: List[ObjectRef] = []
+    large = []
+
+    def one(pos_key, val):
+        if isinstance(val, ObjectRef):
+            refs.append(val)
+            return {"ref": [val.object_id().binary(), list(val.owner_address or ())]}
+        payload, contained = serialization.serialize_inline(val)
+        if len(payload["p"]) + sum(len(b) for b in payload["b"]) > inline_threshold:
+            large.append((pos_key, val))
+            return {"big": pos_key}
+        refs.extend(contained)
+        return {"v": payload}
+
+    for i, a in enumerate(args):
+        wire.append(["p", i, one(("p", i), a)])
+    for k, v in (kwargs or {}).items():
+        wire.append(["k", k, one(("k", k), v)])
+    return wire, refs, large
+
+
+def build_task_spec(
+    *,
+    task_id: TaskID,
+    job_id: JobID,
+    name: str,
+    fn_key: bytes,
+    wire_args: list,
+    num_returns: int,
+    resources: Dict[str, float],
+    owner_addr: Tuple[str, int],
+    owner_worker_id: bytes,
+    max_retries: int = 0,
+    retry_exceptions: bool = False,
+    scheduling_strategy: Optional[dict] = None,
+    task_type: int = TASK_NORMAL,
+    actor_id: Optional[ActorID] = None,
+    seq_no: int = 0,
+    method_name: str = "",
+    runtime_env: Optional[dict] = None,
+    max_concurrency: int = 1,
+    max_restarts: int = 0,
+    caller_id: bytes = b"",
+) -> dict:
+    return {
+        "task_id": task_id.binary(),
+        "job_id": job_id.binary(),
+        "name": name,
+        "fn_key": fn_key,
+        "args": wire_args,
+        "num_returns": num_returns,
+        "resources": resources,
+        "owner_addr": list(owner_addr),
+        "owner_worker_id": owner_worker_id,
+        "max_retries": max_retries,
+        "retry_exceptions": retry_exceptions,
+        "strategy": scheduling_strategy or {},
+        "type": task_type,
+        "actor_id": actor_id.binary() if actor_id else b"",
+        "seq_no": seq_no,
+        "method_name": method_name,
+        "runtime_env": runtime_env or {},
+        "max_concurrency": max_concurrency,
+        "max_restarts": max_restarts,
+        "caller_id": caller_id,
+    }
+
+
+def return_object_ids(spec: dict) -> List[ObjectID]:
+    tid = TaskID(spec["task_id"])
+    return [ObjectID.from_task(tid, i + 1) for i in range(spec["num_returns"])]
+
+
+def scheduling_key(spec: dict) -> tuple:
+    """Leases are cached per (function, resource shape, strategy) like the
+    reference's SchedulingKey (reference: normal_task_submitter.h)."""
+    res = tuple(sorted(spec["resources"].items()))
+    strat = tuple(sorted((k, str(v)) for k, v in spec["strategy"].items()))
+    return (spec["fn_key"], res, strat)

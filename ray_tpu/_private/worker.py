@@ -1,0 +1,1263 @@
+"""CoreWorker: the runtime inside every worker and driver process.
+
+Counterpart of the reference's CoreWorker
+(reference: src/ray/core_worker/core_worker.h:295 — SubmitTask
+core_worker.cc:2166, Get :1552, HandlePushTask :3483) plus the
+NormalTaskSubmitter lease/push pipeline
+(reference: transport/normal_task_submitter.cc:24,:299,:547) and the
+ActorTaskSubmitter ordered queues (reference: transport/actor_task_submitter.h:73).
+
+Threading model: one background asyncio IO loop per process runs every RPC
+(client and server). Synchronous user threads (driver API, task execution
+threads) post coroutines to it and block on futures. Serialization and plasma
+reads/writes happen on user threads to keep the IO loop responsive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._native.plasma import PlasmaClient
+from ray_tpu._private import serialization, task_spec as ts
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.executor import Executor
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.gcs.client import GcsAioClient, GcsClient
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.memory_store import InPlasma, MemoryStore
+from ray_tpu._private.object_ref import ObjectRef, set_worker_hooks
+from ray_tpu._private.reference_counter import ReferenceCounter
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, IoThread, RemoteError, RpcClient, RpcServer
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    OwnerDiedError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+_INLINE = "inline"
+_ERR = "err"
+
+
+class PlasmaValueBuffer:
+    """Buffer-protocol wrapper (PEP 688) tying a plasma pin to value lifetime.
+
+    Arrays deserialized zero-copy from plasma keep a reference to their buffer;
+    when the last buffer of an object dies, the shared handle releases the
+    plasma pin so the store may reclaim the memory (matches the reference
+    plasma client's buffer refcounting, reference: plasma/client.cc).
+    """
+
+    __slots__ = ("_mv", "_handle")
+
+    def __init__(self, mv: memoryview, handle: "_PinHandle"):
+        self._mv = mv
+        self._handle = handle
+        handle.count += 1
+
+    def __buffer__(self, flags):
+        return self._mv
+
+    def __len__(self):
+        return self._mv.nbytes
+
+    def __del__(self):
+        self._handle.dec()
+
+
+class _PinHandle:
+    __slots__ = ("count", "_release")
+
+    def __init__(self, release):
+        self.count = 0
+        self._release = release
+
+    def dec(self):
+        self.count -= 1
+        if self.count <= 0 and self._release is not None:
+            release, self._release = self._release, None
+            try:
+                release()
+            except Exception:
+                pass
+
+
+class TaskEventBuffer:
+    """Buffered task state transitions flushed to the GCS task-event sink
+    (reference: src/ray/core_worker/task_event_buffer.h:206)."""
+
+    def __init__(self, core):
+        self.core = core
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def record(self, spec: dict, state: str, error: str = ""):
+        ev = {
+            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes) else spec["task_id"],
+            "name": spec.get("name", ""),
+            "job_id": spec.get("job_id", b"").hex() if isinstance(spec.get("job_id"), bytes) else "",
+            "state": state,
+            "ts": time.time(),
+            "node_id": self.core.node_id.hex() if self.core.node_id else "",
+            "worker_id": self.core.worker_id.hex(),
+            "error": error,
+            "actor_id": spec.get("actor_id", b"").hex() if spec.get("actor_id") else "",
+        }
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > RTPU_CONFIG.task_events_max_buffer:
+                del self._events[: len(self._events) // 2]
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+
+class _LeaseState:
+    __slots__ = ("idle", "queue", "requests_in_flight", "all_leases")
+
+    def __init__(self):
+        self.idle: deque = deque()   # lease dicts ready for reuse
+        self.queue: deque = deque()  # task specs waiting for a lease
+        self.requests_in_flight = 0
+        self.all_leases: set = set()
+
+
+class _ActorSubmitter:
+    __slots__ = (
+        "actor_id", "state", "addr", "seq", "buffer", "inflight", "watched",
+        "death_cause", "creation_refs",
+    )
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.state = "UNKNOWN"
+        self.addr: Optional[Tuple[str, int]] = None
+        self.seq = 0
+        self.buffer: deque = deque()  # specs waiting for ALIVE
+        self.inflight: Dict[bytes, dict] = {}  # task_id -> spec
+        self.watched = False
+        self.death_cause = ""
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,
+        gcs_address: str,
+        raylet_addr: Tuple[str, int],
+        job_id: JobID,
+        startup_token: int = -1,
+        session_dir: str = "",
+        host: str = "127.0.0.1",
+    ):
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = WorkerID.from_random()
+        self.host = host
+        self.session_dir = session_dir
+        self.io = IoThread.current()
+        self.inline_threshold = RTPU_CONFIG.max_direct_call_object_size
+
+        self.server = RpcServer(host)
+        self.pool = ClientPool()
+        gcs_host, gcs_port = gcs_address.rsplit(":", 1)
+        self.gcs_aio = GcsAioClient(gcs_host, int(gcs_port))
+        self.gcs = GcsClient(gcs_host, int(gcs_port), self.io)
+        self.functions = FunctionManager(self.gcs.kv_put, self.gcs.kv_get)
+
+        self.memory_store = MemoryStore()
+        self.refs = ReferenceCounter(self._on_ref_zero)
+        self.executor = Executor(self)
+        self.task_events = TaskEventBuffer(self)
+
+        self.node_id: Optional[NodeID] = None
+        self.plasma: Optional[PlasmaClient] = None
+        self.raylet: Optional[RpcClient] = None
+        self._raylet_addr = raylet_addr
+        self._startup_token = startup_token
+
+        # ownership / submission state (IO-loop only)
+        self._leases: Dict[tuple, _LeaseState] = {}
+        self._pending_tasks: Dict[bytes, dict] = {}  # task_id -> record
+        self._actor_submitters: Dict[bytes, _ActorSubmitter] = {}
+        self._running_async: Dict[bytes, Any] = {}  # task_id -> cancellable future
+        self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
+        self._node_cache: Dict[bytes, dict] = {}
+        self._node_cache_time = 0.0
+        self._lineage: Dict[bytes, dict] = {}  # task_id -> spec (for reconstruction)
+        self._lineage_bytes = 0
+
+        # task context for the executing thread
+        self._ctx = threading.local()
+        self._put_index_lock = threading.Lock()
+        self._put_index = 0
+        self._driver_task_id = TaskID.for_task(job_id)
+
+        self.actor_id: Optional[bytes] = None
+        self._actor_spec: Optional[dict] = None
+        self.is_shutdown = False
+
+        set_worker_hooks(self)
+        # Connect (blocking): start server, register with raylet, attach plasma.
+        self.io.run(self._connect())
+
+    # ------------------------------------------------------------- connect
+
+    async def _connect(self):
+        self.server.register_all(self)
+        self.port = await self.server.start(0)
+        self.raylet = RpcClient(*self._raylet_addr)
+        await self.raylet.connect()
+        reply = await self.raylet.call(
+            "RegisterWorker",
+            {
+                "worker_id": self.worker_id.binary(),
+                "port": self.port,
+                "pid": os.getpid(),
+                "startup_token": self._startup_token,
+                "job_id": self.job_id.binary(),
+            },
+        )
+        self.node_id = NodeID(reply["node_id"])
+        self.plasma = PlasmaClient(reply["plasma_name"])
+        self.address = (self.host, self.port)
+        asyncio.ensure_future(self._task_event_flush_loop())
+        asyncio.ensure_future(self._pubsub_loop())
+        if self.mode == MODE_WORKER:
+            asyncio.ensure_future(self._watch_raylet())
+
+    async def _watch_raylet(self):
+        """Workers die with their raylet (reference: worker <-> raylet socket)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if not self.raylet.is_connected():
+                os._exit(1)
+            if os.getppid() == 1:
+                os._exit(1)
+
+    async def _task_event_flush_loop(self):
+        period = RTPU_CONFIG.task_events_flush_period_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            events = self.task_events.drain()
+            if events:
+                try:
+                    await self.gcs_aio.notify("AddTaskEvents", {"events": events})
+                except Exception:
+                    pass
+
+    # ------------------------------------------------ ObjectRef hooks (sync)
+
+    def add_local_ref(self, ref: ObjectRef):
+        oid = ref.object_id()
+        if self.refs.owns(oid):
+            self.refs.add_local_ref(oid)
+        else:
+            first = self.refs.add_borrowed_ref(oid, ref.owner_address)
+            if first and ref.owner_address and tuple(ref.owner_address) != self.address:
+                self._post_owner_notify(
+                    ref.owner_address,
+                    "AddBorrowerRef",
+                    {"object_id": oid.binary(), "borrower": list(self.address)},
+                )
+
+    def remove_local_ref(self, ref: ObjectRef):
+        if self.is_shutdown:
+            return
+        oid = ref.object_id()
+        if self.refs.owns(oid):
+            self.refs.remove_local_ref(oid)
+        else:
+            owner = self.refs.remove_borrowed_ref(oid)
+            if owner and tuple(owner) != self.address:
+                self._post_owner_notify(
+                    owner,
+                    "RemoveBorrowerRef",
+                    {"object_id": oid.binary(), "borrower": list(self.address)},
+                )
+
+    def _post_owner_notify(self, owner_addr, method, payload):
+        async def go():
+            try:
+                client = await self.pool.get(owner_addr[0], owner_addr[1])
+                await client.notify(method, payload)
+            except Exception:
+                pass
+
+        try:
+            self.io.post(go())
+        except Exception:
+            pass
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        def done(task):
+            try:
+                out.set_result(self.get([ref], timeout=None)[0])
+            except Exception as e:
+                out.set_exception(e)
+
+        f = self.io.post(self._async_resolve(ref, None))
+        f.add_done_callback(done)
+        return out
+
+    async def await_ref(self, ref: ObjectRef):
+        res = await self._async_resolve(ref, None)
+        value = self._materialize(ref.object_id(), res)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def _on_ref_zero(self, oid: ObjectID):
+        """Owned object's refcount hit zero: free it everywhere."""
+
+        async def free():
+            entry = self.memory_store.get_if_exists(oid)
+            self.memory_store.free(oid)
+            locations = self._object_locations.pop(oid.binary(), set())
+            if isinstance(entry, InPlasma):
+                locations |= entry.locations
+            if locations:
+                await self._free_plasma_copies(oid, locations)
+
+        try:
+            self.io.post(free())
+        except Exception:
+            pass
+
+    async def _free_plasma_copies(self, oid: ObjectID, locations):
+        for node_id in locations:
+            info = await self._node_info(node_id)
+            if info is None:
+                continue
+            try:
+                client = await self.pool.get(info["ip"], info["raylet_port"])
+                await client.notify("FreeObjects", {"ids": [oid.binary()]})
+            except Exception:
+                pass
+
+    async def _node_info(self, node_id: bytes) -> Optional[dict]:
+        now = time.time()
+        if node_id not in self._node_cache or now - self._node_cache_time > 5.0:
+            try:
+                nodes = await self.gcs_aio.get_all_node_info()
+                self._node_cache = {n["node_id"]: n for n in nodes}
+                self._node_cache_time = now
+            except Exception:
+                pass
+        return self._node_cache.get(node_id)
+
+    # ------------------------------------------------------------ put / get
+
+    def _next_put_id(self) -> ObjectID:
+        with self._put_index_lock:
+            self._put_index += 1
+            idx = self._put_index
+        return ObjectID.for_put(self.current_task_id(), idx)
+
+    def current_task_id(self) -> TaskID:
+        spec = getattr(self._ctx, "spec", None)
+        if spec is not None:
+            return TaskID(spec["task_id"])
+        return self._driver_task_id
+
+    def put(self, value: Any, _owner_hint=None) -> ObjectRef:
+        """Store a value, return an owned ref (reference: worker.py:2691 ray.put)."""
+        oid = self._next_put_id()
+        payload, _refs = serialization.serialize_inline(value)
+        size = len(payload["p"]) + sum(len(b) for b in payload["b"])
+        self.refs.add_owned(oid)
+        if size <= self.inline_threshold:
+            self.io.run(self._store_inline(oid, payload))
+        else:
+            blob = self._payload_to_blob(payload)
+            self._plasma_put_local(oid, blob)
+            self.io.run(self._register_plasma_primary(oid, len(blob)))
+        return ObjectRef(oid, self.address)
+
+    async def _store_inline(self, oid: ObjectID, payload):
+        self.memory_store.put(oid, (_INLINE, payload, None))
+
+    @staticmethod
+    def _payload_to_blob(payload) -> bytes:
+        out = bytearray(serialization.blob_size(payload["p"], payload["b"]))
+        n = serialization.write_blob(memoryview(out), payload["p"], payload["b"])
+        return bytes(out[:n])
+
+    def _plasma_put_local(self, oid: ObjectID, blob: bytes):
+        try:
+            self.plasma.put_blob(oid, blob)
+        except Exception:
+            # OOM: evict and retry once
+            self.plasma.evict(len(blob))
+            self.plasma.put_blob(oid, blob)
+
+    async def _register_plasma_primary(self, oid: ObjectID, size: int):
+        node = self.node_id.binary()
+        self.memory_store.put(oid, InPlasma(size, {node}))
+        self._object_locations.setdefault(oid.binary(), set()).add(node)
+        try:
+            await self.raylet.notify(
+                "PinObject", {"object_id": oid.binary(), "owner_addr": list(self.address)}
+            )
+        except Exception:
+            pass
+
+    # -- get ---------------------------------------------------------------
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        resolutions = self.io.run(self._async_resolve_many(refs, deadline))
+        out = []
+        for ref, res in zip(refs, resolutions):
+            value = self._materialize(ref.object_id(), res)
+            if isinstance(value, Exception):
+                raise value
+            out.append(value)
+        return out
+
+    async def async_get_one(self, ref: ObjectRef):
+        """IO-loop get used by the executor for dependency resolution."""
+        res = await self._async_resolve(ref, None)
+        loop = asyncio.get_running_loop()
+        value = await loop.run_in_executor(None, self._materialize, ref.object_id(), res)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    async def _async_resolve_many(self, refs, deadline):
+        tasks = [self._async_resolve(r, deadline) for r in refs]
+        return await asyncio.gather(*tasks)
+
+    async def _async_resolve(self, ref: ObjectRef, deadline) -> tuple:
+        """Resolve a ref to ('inline'|'err', payload) | ('plasma_local', oid) on IO loop."""
+        oid = ref.object_id()
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.refs.owns(oid) or self.memory_store.contains(oid) or self.memory_store.is_pending(oid):
+                res = await self._resolve_owned(oid, deadline)
+            else:
+                res = await self._resolve_borrowed(ref, deadline)
+            if res[0] != "plasma_remote_lost":
+                return res
+            # All copies lost: try lineage reconstruction
+            # (reference: object_recovery_manager.h:41).
+            if attempt > 2 or not await self._try_reconstruct(oid):
+                return ("err_obj", ObjectLostError(f"object {oid.hex()} lost (all copies gone)"))
+
+    async def _resolve_owned(self, oid: ObjectID, deadline) -> tuple:
+        timeout = None if deadline is None else max(0.0, deadline - time.time())
+        ready = await self.memory_store.wait_ready(oid, timeout)
+        if not ready:
+            return ("err_obj", GetTimeoutError(f"get() timed out on {oid.hex()}"))
+        entry = self.memory_store.get_if_exists(oid)
+        if entry is None:
+            return ("err_obj", ObjectLostError(f"object {oid.hex()} was freed"))
+        if isinstance(entry, InPlasma):
+            return await self._resolve_plasma(oid, entry.locations, None, deadline)
+        return entry[:2] if entry[0] in (_INLINE, _ERR) else ("value", entry)
+
+    async def _resolve_borrowed(self, ref: ObjectRef, deadline) -> tuple:
+        oid = ref.object_id()
+        owner = ref.owner_address
+        if owner is None:
+            return ("err_obj", OwnerDiedError(f"no owner known for {oid.hex()}"))
+        while True:
+            timeout = 25.0
+            if deadline is not None:
+                timeout = min(timeout, deadline - time.time())
+                if timeout <= 0:
+                    return ("err_obj", GetTimeoutError(f"get() timed out on {oid.hex()}"))
+            try:
+                client = await self.pool.get(owner[0], owner[1])
+                status = await client.call(
+                    "GetObjectStatus",
+                    {"object_id": oid.binary(), "wait": True, "timeout": timeout},
+                    timeout=timeout + 5,
+                )
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                return ("err_obj", OwnerDiedError(f"owner of {oid.hex()} is unreachable"))
+            st = status.get("status")
+            if st == "pending":
+                continue
+            if st == "freed":
+                return ("err_obj", ObjectLostError(f"object {oid.hex()} was freed by owner"))
+            if "inline" in status:
+                return (_INLINE, status["inline"])
+            if "err" in status:
+                return (_ERR, status["err"])
+            if "plasma" in status:
+                return await self._resolve_plasma(
+                    oid, set(status["plasma"]["locations"]), owner, deadline
+                )
+
+    async def _resolve_plasma(self, oid: ObjectID, locations, owner, deadline) -> tuple:
+        if self.plasma.contains(oid):
+            return ("plasma_local", oid)
+        owner_addr = list(owner) if owner else list(self.address)
+        try:
+            timeout = None if deadline is None else max(0.1, deadline - time.time())
+            reply = await self.raylet.call(
+                "PullObject",
+                {"object_id": oid.binary(), "owner_addr": owner_addr},
+                timeout=timeout,
+            )
+        except asyncio.TimeoutError:
+            return ("err_obj", GetTimeoutError(f"get() timed out pulling {oid.hex()}"))
+        if reply.get("ok") and self.plasma.contains(oid):
+            return ("plasma_local", oid)
+        return ("plasma_remote_lost", oid)
+
+    def _materialize(self, oid: ObjectID, res: tuple):
+        """User-thread side: turn a resolution into a Python value (may raise)."""
+        kind = res[0]
+        if kind == "value":
+            return res[1]
+        if kind == "err_obj":
+            return res[1]
+        if kind == _INLINE:
+            value, _refs = serialization.deserialize_inline(res[1])
+            return value
+        if kind == _ERR:
+            exc, _refs = serialization.deserialize_inline(res[1])
+            if isinstance(exc, Exception):
+                return TaskError(exc, getattr(exc, "_rtpu_tb", str(exc)))
+            return TaskError(Exception(str(exc)), str(exc))
+        if kind == "plasma_local":
+            return self._read_plasma_value(oid)
+        raise RuntimeError(f"bad resolution {res}")
+
+    def _read_plasma_value(self, oid: ObjectID):
+        view = self.plasma.get(oid)
+        if view is None:
+            return ObjectLostError(f"object {oid.hex()} evicted before read")
+        import struct as _struct
+
+        src = view
+        magic, plen = _struct.unpack_from("<II", src, 0)
+        off = 8
+        pickle_bytes = bytes(src[off : off + plen])
+        off += plen
+        (nbuf,) = _struct.unpack_from("<I", src, off)
+        off += 4
+        if nbuf == 0:
+            view.release()
+            self.plasma.release(oid)
+            value, _ = serialization.deserialize(pickle_bytes, [])
+            return value
+
+        def release():
+            try:
+                view.release()
+            except Exception:
+                pass
+            self.plasma.release(oid)
+
+        handle = _PinHandle(release)
+        buffers = []
+        for _ in range(nbuf):
+            (blen,) = _struct.unpack_from("<Q", src, off)
+            off += 8
+            off = (off + 63) & ~63
+            buffers.append(PlasmaValueBuffer(src[off : off + blen], handle))
+            off += blen
+        value, _refs = serialization.deserialize(pickle_bytes, buffers)
+        del buffers
+        return value
+
+    # ------------------------------------------------------------ wait
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.time() + timeout
+        return self.io.run(self._async_wait(refs, num_returns, deadline, fetch_local))
+
+    async def _async_wait(self, refs, num_returns, deadline, fetch_local):
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            still = []
+            for ref in pending:
+                if await self._is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.time() >= deadline:
+                break
+            await asyncio.sleep(0.005)
+        return ready, pending
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.object_id()
+        if self.memory_store.contains(oid):
+            return True
+        if self.memory_store.is_pending(oid):
+            return False
+        if self.plasma.contains(oid):
+            return True
+        if self.refs.owns(oid):
+            return False
+        owner = ref.owner_address
+        if owner is None:
+            return False
+        try:
+            client = await self.pool.get(owner[0], owner[1])
+            status = await client.call(
+                "GetObjectStatus", {"object_id": oid.binary(), "wait": False}, timeout=10
+            )
+            return status.get("status") == "ready" or "inline" in status or "plasma" in status or "err" in status
+        except Exception:
+            return False
+
+    # ----------------------------------------------------- normal task submit
+
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: Dict[str, float],
+        max_retries: int = 0,
+        retry_exceptions: bool = False,
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        fn_key = self.functions.export(fn)
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_task(self.job_id)
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name,
+            fn_key=fn_key,
+            wire_args=wire,
+            num_returns=num_returns,
+            resources=resources,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env,
+        )
+        return_refs = self._register_pending(spec, refs)
+        self.io.post(self._submit_normal(spec))
+        return return_refs
+
+    def _replace_large_args(self, wire, large) -> List[ObjectRef]:
+        """Oversized inline args are put() first and passed by ref
+        (reference: dependency_resolver.h inlining threshold)."""
+        big_refs = []
+        if not large:
+            return big_refs
+        by_key = {}
+        for pos_key, val in large:
+            ref = self.put(val)
+            big_refs.append(ref)
+            by_key[pos_key] = ref
+        for entry in wire:
+            w = entry[2]
+            if "big" in w:
+                key = tuple(w["big"])
+                ref = by_key[(key[0], key[1] if key[0] == "k" else int(key[1]))]
+                entry[2] = {"ref": [ref.object_id().binary(), list(ref.owner_address)]}
+        return big_refs
+
+    def _register_pending(self, spec: dict, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        return_ids = ts.return_object_ids(spec)
+        out = []
+        for oid in return_ids:
+            self.refs.add_owned(oid, lineage_task_id=spec["task_id"])
+        self.io.run(self._mark_pending(return_ids))
+        for oid in return_ids:
+            out.append(ObjectRef(oid, self.address))
+        for ref in arg_refs:
+            if self.refs.owns(ref.object_id()):
+                self.refs.add_submitted_task_ref(ref.object_id())
+        self._pending_tasks[spec["task_id"]] = {
+            "spec": spec,
+            "retries": spec.get("max_retries", 0),
+            "arg_refs": list(arg_refs),
+            "return_ids": return_ids,
+        }
+        self.task_events.record(spec, "PENDING")
+        return out
+
+    async def _mark_pending(self, return_ids):
+        for oid in return_ids:
+            self.memory_store.put_pending(oid)
+
+    async def _submit_normal(self, spec: dict):
+        key = ts.scheduling_key(spec)
+        state = self._leases.setdefault(key, _LeaseState())
+        state.queue.append(spec)
+        await self._pump_leases(key, state)
+
+    async def _pump_leases(self, key, state: _LeaseState):
+        while state.queue and state.idle:
+            lease = state.idle.popleft()
+            spec = state.queue.popleft()
+            asyncio.ensure_future(self._push_on_lease(key, state, lease, spec))
+        need = len(state.queue) - state.requests_in_flight
+        for _ in range(min(need, 64)):
+            state.requests_in_flight += 1
+            asyncio.ensure_future(self._request_lease(key, state))
+
+    async def _request_lease(self, key, state: _LeaseState, raylet_client=None, hops=0):
+        try:
+            if not state.queue:
+                return
+            sample = state.queue[0]
+            client = raylet_client or self.raylet
+            try:
+                reply = await client.call(
+                    "RequestWorkerLease",
+                    {
+                        "resources": sample["resources"],
+                        "strategy": sample["strategy"],
+                        "job_id": sample["job_id"],
+                    },
+                    timeout=RTPU_CONFIG.worker_lease_timeout_ms / 1000.0 + 10,
+                )
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                if raylet_client is not None:
+                    # spill target died; go back to local raylet
+                    state.requests_in_flight += 1
+                    asyncio.ensure_future(self._request_lease(key, state))
+                return
+            if reply.get("granted"):
+                lease = {
+                    "worker_addr": tuple(reply["worker_addr"]),
+                    "worker_id": reply["worker_id"],
+                    "lease_id": reply["lease_id"],
+                    "raylet": client,
+                }
+                state.all_leases.add(reply["lease_id"])
+                if state.queue:
+                    spec = state.queue.popleft()
+                    asyncio.ensure_future(self._push_on_lease(key, state, lease, spec))
+                else:
+                    await self._return_lease(state, lease)
+            elif reply.get("spill"):
+                target = reply["spill"]
+                peer = await self.pool.get(target["ip"], target["port"])
+                state.requests_in_flight += 1
+                if hops < 4:
+                    asyncio.ensure_future(self._request_lease(key, state, peer, hops + 1))
+                else:
+                    asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("retry"):
+                state.requests_in_flight += 1
+                asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("error"):
+                err = RuntimeError(reply["error"])
+                while state.queue:
+                    spec = state.queue.popleft()
+                    self._fail_task(spec, err)
+        finally:
+            state.requests_in_flight -= 1
+
+    async def _push_on_lease(self, key, state: _LeaseState, lease, spec: dict):
+        try:
+            client = await self.pool.get(*lease["worker_addr"])
+            self._pending_tasks.get(spec["task_id"], {})["lease"] = lease
+            self.task_events.record(spec, "SUBMITTED")
+            reply = await client.call("PushTask", {"spec": spec}, timeout=None)
+        except (ConnectionLost, OSError) as e:
+            state.all_leases.discard(lease["lease_id"])
+            await self._handle_worker_crash(spec, e)
+            await self._pump_leases(key, state)
+            return
+        await self._process_task_reply(spec, reply)
+        # reuse the lease for queued work, else return it
+        if state.queue:
+            next_spec = state.queue.popleft()
+            asyncio.ensure_future(self._push_on_lease(key, state, lease, next_spec))
+        else:
+            await self._return_lease(state, lease)
+
+    async def _return_lease(self, state: _LeaseState, lease):
+        state.all_leases.discard(lease["lease_id"])
+        try:
+            await lease["raylet"].notify(
+                "ReturnWorker", {"worker_id": lease["worker_id"], "lease_id": lease["lease_id"]}
+            )
+        except Exception:
+            pass
+
+    async def _handle_worker_crash(self, spec: dict, err):
+        record = self._pending_tasks.get(spec["task_id"])
+        if record and record["retries"] > 0:
+            record["retries"] -= 1
+            self.task_events.record(spec, "RETRY")
+            await self._submit_normal(spec)
+        else:
+            self._fail_task(spec, WorkerCrashedError(f"worker died executing {spec['name']}: {err}"))
+
+    def _fail_task(self, spec: dict, error: Exception):
+        record = self._pending_tasks.pop(spec["task_id"], None)
+        payload, _ = serialization.serialize_inline(error)
+        for oid in ts.return_object_ids(spec):
+            self.memory_store.put(oid, (_ERR, payload, None))
+        self.task_events.record(spec, "FAILED", error=str(error)[:500])
+        if record:
+            self._release_task_arg_refs(record)
+
+    def _release_task_arg_refs(self, record):
+        for ref in record.get("arg_refs", []):
+            if self.refs.owns(ref.object_id()):
+                self.refs.remove_submitted_task_ref(ref.object_id())
+        record["arg_refs"] = []
+
+    async def _process_task_reply(self, spec: dict, reply: dict):
+        record = self._pending_tasks.get(spec["task_id"])
+        if reply.get("status") == "error":
+            if reply.get("app_error") and spec.get("retry_exceptions") and record and record["retries"] > 0:
+                record["retries"] -= 1
+                await self._submit_normal(spec)
+                return
+            if reply.get("cancelled"):
+                err_payload, _ = serialization.serialize_inline(TaskCancelledError())
+            elif "exception" in reply:
+                err_payload = reply["exception"]
+            else:
+                err_payload, _ = serialization.serialize_inline(RuntimeError(reply.get("error", "task failed")))
+            for oid in ts.return_object_ids(spec):
+                self.memory_store.put(oid, (_ERR, err_payload, None))
+            self.task_events.record(spec, "FAILED", error=str(reply.get("error", ""))[:300])
+        else:
+            return_ids = ts.return_object_ids(spec)
+            any_plasma = False
+            for oid, result in zip(return_ids, reply["results"]):
+                if "inline" in result:
+                    self.memory_store.put(oid, (_INLINE, result["inline"], None))
+                elif "plasma" in result:
+                    meta = result["plasma"]
+                    any_plasma = True
+                    self.memory_store.put(oid, InPlasma(meta["size"], {meta["node_id"]}))
+                    self._object_locations.setdefault(oid.binary(), set()).add(meta["node_id"])
+            if any_plasma:
+                self._store_lineage(spec)
+        self._pending_tasks.pop(spec["task_id"], None)
+        if record:
+            self._release_task_arg_refs(record)
+
+    def _store_lineage(self, spec: dict):
+        """Keep specs that can recreate lost plasma returns
+        (reference: task_manager.h:208 lineage, :215 max_lineage_bytes)."""
+        est = 256 + sum(len(str(a)) for a in spec.get("args", []))
+        if self._lineage_bytes + est > RTPU_CONFIG.max_lineage_bytes:
+            return
+        self._lineage[spec["task_id"]] = spec
+        self._lineage_bytes += est
+
+    async def _try_reconstruct(self, oid: ObjectID) -> bool:
+        task_id = oid.task_id().binary()
+        spec = self._lineage.get(task_id)
+        if spec is None:
+            return False
+        self.memory_store.free(oid)
+        for rid in ts.return_object_ids(spec):
+            self.memory_store.put_pending(rid)
+        self._pending_tasks[spec["task_id"]] = {
+            "spec": spec, "retries": 0, "arg_refs": [], "return_ids": ts.return_object_ids(spec),
+        }
+        await self._submit_normal(spec)
+        return True
+
+    # ----------------------------------------------------------- actor submit
+
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        *,
+        name: str = "",
+        namespace: str = "",
+        num_returns: int = 0,
+        resources: Dict[str, float],
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        lifetime: str = "",
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> bytes:
+        actor_id = ActorID.of(self.job_id)
+        fn_key = self.functions.export(cls)
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=f"{name or getattr(cls, '__name__', 'Actor')}.__init__",
+            fn_key=fn_key,
+            wire_args=wire,
+            num_returns=0,
+            resources=resources,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            scheduling_strategy=scheduling_strategy,
+            task_type=ts.TASK_ACTOR_CREATION,
+            actor_id=actor_id,
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env,
+        )
+        # Hold arg refs until creation completes (GCS drives creation).
+        sub = _ActorSubmitter(actor_id.binary())
+        sub.state = "PENDING_CREATION"
+        self._actor_submitters[actor_id.binary()] = sub
+        self.gcs.call(
+            "RegisterActor",
+            {
+                "actor_id": actor_id.binary(),
+                "creation_spec": spec,
+                "name": name,
+                "namespace": namespace,
+                "max_restarts": max_restarts,
+                "detached": lifetime == "detached",
+            },
+        )
+        self.io.post(self._watch_actor(actor_id.binary()))
+        # keep creation arg refs alive until ALIVE (bound to submitter)
+        sub.creation_refs = refs  # type: ignore[attr-defined]
+        return actor_id.binary()
+
+    def submit_actor_task(
+        self, actor_id: bytes, method_name: str, args, kwargs, *, num_returns=1, name=""
+    ) -> List[ObjectRef]:
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_task(self.job_id)
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name or method_name,
+            fn_key=b"",
+            wire_args=wire,
+            num_returns=num_returns,
+            resources={},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            task_type=ts.TASK_ACTOR,
+            actor_id=ActorID(actor_id),
+            method_name=method_name,
+            caller_id=self.worker_id.binary(),
+        )
+        return_refs = self._register_pending(spec, refs)
+        self.io.post(self._submit_actor_task(actor_id, spec))
+        return return_refs
+
+    async def _submit_actor_task(self, actor_id: bytes, spec: dict):
+        sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
+        sub.seq += 1
+        spec["seq_no"] = sub.seq
+        if not sub.watched:
+            sub.watched = True
+            asyncio.ensure_future(self._watch_actor(actor_id))
+        if sub.state == "ALIVE" and sub.addr:
+            asyncio.ensure_future(self._push_actor_task(sub, spec))
+        elif sub.state == "DEAD":
+            self._fail_task(spec, ActorDiedError(actor_id, sub.death_cause or "actor is dead"))
+        else:
+            sub.buffer.append(spec)
+            if sub.state == "UNKNOWN":
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+
+    async def _push_actor_task(self, sub: _ActorSubmitter, spec: dict):
+        sub.inflight[spec["task_id"]] = spec
+        try:
+            client = await self.pool.get(*sub.addr)
+            self.task_events.record(spec, "SUBMITTED")
+            reply = await client.call("PushActorTask", {"spec": spec}, timeout=None)
+        except (ConnectionLost, OSError):
+            # actor worker died; buffer for restart or fail on DEAD
+            sub.buffer.appendleft(spec)
+            sub.state = "RESTARTING?"
+            asyncio.ensure_future(self._refresh_actor_state(sub))
+            return
+        finally:
+            sub.inflight.pop(spec["task_id"], None)
+        await self._process_task_reply(spec, reply)
+
+    async def _refresh_actor_state(self, sub: _ActorSubmitter):
+        try:
+            info = await self.gcs_aio.call("GetActorInfo", {"actor_id": sub.actor_id})
+        except Exception:
+            return
+        if not info.get("found"):
+            return
+        await self._apply_actor_state(sub, info["actor"])
+
+    async def _apply_actor_state(self, sub: _ActorSubmitter, rec: dict):
+        state = rec["state"]
+        if state == "ALIVE" and rec.get("addr"):
+            new_addr = tuple(rec["addr"])
+            restarted = sub.addr is not None and new_addr != sub.addr
+            sub.addr = new_addr
+            sub.state = "ALIVE"
+            if restarted:
+                sub.seq = sub.seq  # seq keeps increasing; receiver reorders from first seen
+            if hasattr(sub, "creation_refs"):
+                del sub.creation_refs
+            while sub.buffer:
+                spec = sub.buffer.popleft()
+                asyncio.ensure_future(self._push_actor_task(sub, spec))
+        elif state == "DEAD":
+            sub.state = "DEAD"
+            sub.death_cause = rec.get("death_cause", "")
+            err = ActorDiedError(sub.actor_id, f"actor died: {sub.death_cause}")
+            while sub.buffer:
+                self._fail_task(sub.buffer.popleft(), err)
+            for spec in list(sub.inflight.values()):
+                self._fail_task(spec, err)
+            sub.inflight.clear()
+        elif state in ("RESTARTING", "PENDING_CREATION"):
+            sub.state = state
+            sub.addr = None
+
+    async def _watch_actor(self, actor_id: bytes):
+        sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
+        channel = f"actor:{actor_id.hex()}"
+        await self.gcs_aio.call(
+            "Subscribe", {"sub_id": self.worker_id.binary(), "channel": channel}
+        )
+        await self._refresh_actor_state(sub)
+
+    async def _pubsub_loop(self):
+        """Single long-poll loop draining every GCS channel we subscribe to."""
+        while True:
+            try:
+                reply = await self.gcs_aio.call(
+                    "PubsubPoll",
+                    {"sub_id": self.worker_id.binary(), "timeout": 20.0},
+                    timeout=40.0,
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            for channel, msg in reply.get("batch", []):
+                if channel.startswith("actor:"):
+                    actor_id = msg["actor_id"]
+                    sub = self._actor_submitters.get(actor_id)
+                    if sub is not None:
+                        rec = {
+                            "state": msg["state"],
+                            "addr": msg.get("addr"),
+                            "death_cause": msg.get("death_cause", ""),
+                        }
+                        await self._apply_actor_state(sub, rec)
+
+    def kill_actor(self, actor_id: bytes, no_restart=True):
+        self.gcs.call("KillActor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    def cancel_task(self, ref: ObjectRef, force=False, recursive=True):
+        async def go():
+            task_id = ref.object_id().task_id().binary()
+            record = self._pending_tasks.get(task_id)
+            if record is None:
+                return
+            lease = record.get("lease")
+            addr = None
+            if lease:
+                addr = lease["worker_addr"]
+            else:
+                spec = record["spec"]
+                if spec.get("actor_id"):
+                    sub = self._actor_submitters.get(spec["actor_id"])
+                    if sub and sub.addr:
+                        addr = sub.addr
+            if addr:
+                try:
+                    client = await self.pool.get(*addr)
+                    await client.notify("CancelTask", {"task_id": task_id})
+                except Exception:
+                    pass
+
+        self.io.run(go())
+
+    # ----------------------------------------------------- executor services
+
+    def on_became_actor(self, actor_id: bytes, spec: dict):
+        self.actor_id = actor_id
+        self._actor_spec = spec
+
+    def register_running_task(self, task_id: bytes, fut):
+        self._running_async[task_id] = fut
+
+    def unregister_running_task(self, task_id: bytes):
+        self._running_async.pop(task_id, None)
+
+    def try_cancel_running(self, task_id: bytes):
+        fut = self._running_async.get(task_id)
+        if fut is not None:
+            fut.cancel()
+
+    def push_task_context(self, spec: dict):
+        old = getattr(self._ctx, "spec", None)
+        self._ctx.spec = spec
+        return old
+
+    def pop_task_context(self, old):
+        self._ctx.spec = old
+
+    def current_task_spec(self):
+        return getattr(self._ctx, "spec", None)
+
+    async def put_return_to_plasma(self, oid: ObjectID, payload, spec) -> dict:
+        """Store a large task return into local plasma; owner is the caller."""
+        loop = asyncio.get_running_loop()
+        blob = await loop.run_in_executor(None, self._payload_to_blob, payload)
+        await loop.run_in_executor(None, self._plasma_put_local, oid, blob)
+        try:
+            await self.raylet.notify(
+                "PinObject",
+                {"object_id": oid.binary(), "owner_addr": list(spec["owner_addr"])},
+            )
+        except Exception:
+            pass
+        return {"size": len(blob), "node_id": self.node_id.binary()}
+
+    # -------------------------------------------------------------- handlers
+
+    async def handle_PushTask(self, req):
+        return await self.executor.execute_normal(req["spec"])
+
+    async def handle_CreateActor(self, req):
+        return await self.executor.create_actor(req["spec"], req["actor_id"])
+
+    async def handle_PushActorTask(self, req):
+        return await self.executor.push_actor_task(req["spec"])
+
+    async def handle_GetObjectStatus(self, req):
+        oid = ObjectID(req["object_id"])
+        if req.get("wait"):
+            timeout = min(req.get("timeout", 25.0), 25.0)
+            ready = await self.memory_store.wait_ready(oid, timeout)
+            if not ready:
+                return {"status": "pending"}
+        entry = self.memory_store.get_if_exists(oid)
+        if entry is None:
+            if self.memory_store.is_pending(oid):
+                return {"status": "pending"}
+            if self.refs.owns(oid):
+                return {"status": "pending"}
+            return {"status": "freed"}
+        if isinstance(entry, InPlasma):
+            return {
+                "status": "ready",
+                "plasma": {"size": entry.size, "locations": list(entry.locations)},
+            }
+        kind, payload = entry[0], entry[1]
+        if kind == _ERR:
+            return {"status": "ready", "err": payload}
+        return {"status": "ready", "inline": payload}
+
+    async def handle_AddBorrowerRef(self, req):
+        self.refs.add_borrower(ObjectID(req["object_id"]), tuple(req["borrower"]))
+
+    async def handle_RemoveBorrowerRef(self, req):
+        self.refs.remove_borrower(ObjectID(req["object_id"]), tuple(req["borrower"]))
+
+    async def handle_AddObjectLocation(self, req):
+        oid = ObjectID(req["object_id"])
+        self._object_locations.setdefault(oid.binary(), set()).add(req["node_id"])
+        entry = self.memory_store.get_if_exists(oid)
+        if isinstance(entry, InPlasma):
+            entry.locations.add(req["node_id"])
+
+    async def handle_RemoveObjectLocation(self, req):
+        oid = ObjectID(req["object_id"])
+        self._object_locations.get(oid.binary(), set()).discard(req["node_id"])
+        entry = self.memory_store.get_if_exists(oid)
+        if isinstance(entry, InPlasma):
+            entry.locations.discard(req["node_id"])
+
+    async def handle_CancelTask(self, req):
+        self.executor.cancel(req["task_id"])
+
+    async def handle_KillActor(self, req):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+    async def handle_Exit(self, req):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+    async def handle_Ping(self, req):
+        return {"ok": True, "worker_id": self.worker_id.binary()}
+
+    async def handle_GetCoreWorkerStats(self, req):
+        return {
+            "worker_id": self.worker_id.binary(),
+            "mode": self.mode,
+            "actor_id": self.actor_id,
+            "refs": self.refs.stats(),
+            "memory_store_size": self.memory_store.size(),
+            "pending_tasks": len(self._pending_tasks),
+        }
+
+    # ------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        if self.is_shutdown:
+            return
+        self.is_shutdown = True
+        set_worker_hooks(None)
+        try:
+            self.io.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        self.executor.shutdown()
+        try:
+            if self.plasma:
+                self.plasma.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------- globals
+
+global_worker: Optional[CoreWorker] = None
+
+
+def get_global_worker() -> CoreWorker:
+    if global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker]):
+    global global_worker
+    global_worker = worker

@@ -1,0 +1,1 @@
+from ray_tpu.dag.node import ClassNode, DAGNode, FunctionNode, InputNode  # noqa: F401

@@ -46,6 +46,7 @@ struct Entry {
   uint64_t offset;     // data offset from arena base
   uint64_t data_size;  // usable bytes
   int32_t pin_count;   // readers currently mapping the object
+  uint32_t pending_delete;  // freed by owner; reclaim when pin_count drops to 0
   uint64_t lru_tick;   // last touch, for eviction ordering
 };
 
@@ -375,6 +376,7 @@ int ps_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* out_offs
   e->state = kStateCreated;
   e->offset = off;
   e->data_size = size;
+  e->pending_delete = 0;
   e->pin_count = 1;  // creator holds a pin until seal+release
   e->lru_tick = ++s->hdr->lru_clock;
   s->hdr->num_objects++;
@@ -406,9 +408,9 @@ int ps_get(void* handle, const uint8_t* id, uint64_t* out_offset, uint64_t* out_
     unlock(s);
     return PS_NOT_FOUND;
   }
-  if (e->state != kStateSealed) {
+  if (e->state != kStateSealed || e->pending_delete) {
     unlock(s);
-    return PS_NOT_SEALED;
+    return e->pending_delete ? PS_NOT_FOUND : PS_NOT_SEALED;
   }
   e->pin_count++;
   e->lru_tick = ++s->hdr->lru_clock;
@@ -422,7 +424,7 @@ int ps_contains(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
   lock(s);
   Entry* e = find_entry(s, id);
-  int ok = (e && e->state == kStateSealed) ? 1 : 0;
+  int ok = (e && e->state == kStateSealed && !e->pending_delete) ? 1 : 0;
   unlock(s);
   return ok;
 }
@@ -436,11 +438,19 @@ int ps_release(void* handle, const uint8_t* id) {
     return PS_NOT_FOUND;
   }
   if (e->pin_count > 0) e->pin_count--;
+  if (e->pin_count == 0 && e->pending_delete) {
+    arena_free(s, e->offset);
+    e->state = kStateTombstone;
+    s->hdr->num_objects--;
+  }
   unlock(s);
   return PS_OK;
 }
 
 int ps_delete(void* handle, const uint8_t* id) {
+  // If readers still pin the object, defer reclamation to the last release —
+  // zero-copy views held by live Python values stay valid (same contract as
+  // the reference plasma client's buffer refcounting).
   Store* s = static_cast<Store*>(handle);
   lock(s);
   Entry* e = find_entry(s, id);
@@ -449,6 +459,7 @@ int ps_delete(void* handle, const uint8_t* id) {
     return PS_NOT_FOUND;
   }
   if (e->pin_count > 0) {
+    e->pending_delete = 1;
     unlock(s);
     return PS_PINNED;
   }
