@@ -26,6 +26,8 @@ _FLAGS: Dict[str, Any] = {
     # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
     "scheduler_spread_threshold": 0.5,
     "worker_lease_timeout_ms": 30_000,
+    # How long a PG-bound task waits for its group's 2PC to finish before failing.
+    "placement_group_ready_timeout_s": 60.0,
     # Max idle workers kept alive per node (soft cap, like num_cpus in reference).
     "idle_worker_keep_alive_s": 120.0,
     "worker_startup_timeout_s": 60.0,

@@ -105,7 +105,11 @@ class NodeManager:
             self.gcs_address,
             self.plasma_name,
             self.session_dir,
+            on_worker_death=self._on_worker_death,
         )
+        # Warm the fork server immediately so the first lease forks in ~ms
+        # (reference: worker_pool.h:359 PrestartWorkers).
+        asyncio.ensure_future(self.worker_pool._ensure_fork_server())
         await self.gcs.call(
             "RegisterNode",
             {
@@ -161,12 +165,10 @@ class NodeManager:
 
     async def _reaper_loop(self):
         while True:
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(1.0)
             try:
-                dead = self.worker_pool.reap_dead()
-                for h in dead:
-                    await self._on_worker_death(h)
                 self.worker_pool.reap_idle()
+                self.worker_pool.check_liveness()
             except Exception:
                 logger.exception("reaper error")
 
@@ -176,7 +178,7 @@ class NodeManager:
             if lease["worker_id"] == handle.worker_id:
                 self._release_lease(lease_id)
         actor_id = self._actor_workers.pop(handle.worker_id, None)
-        rc = handle.proc.returncode
+        rc = handle.returncode
         await self.gcs.notify(
             "ReportWorkerDeath",
             {
@@ -293,7 +295,25 @@ class NodeManager:
                 return {"spill": {"ip": target["ip"], "port": target["raylet_port"],
                                    "node_id": target["node_id"]}}
 
+        # PG-bound tasks are routed by the owner to the raylet holding the
+        # bundle; they queue on that bundle and never spill (reference:
+        # local_task_manager keeps PG tasks local to the committed bundle).
+        is_pg = strategy.get("type") == "placement_group"
+        if is_pg:
+            pg_key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
+            bundle = self.bundles.get(pg_key)
+            if bundle is None or not bundle["committed"]:
+                return {"retry_pg": True}
+            if not bundle["reserved"].fits(ResourceSet(resources)):
+                # Fail fast like the reference's submission-time bundle check.
+                return {"error": (
+                    f"task demands {resources} which can never fit in "
+                    f"placement group bundle {bundle['reserved'].to_dict()}"
+                )}
+
         while True:
+            if is_pg and pg_key not in self.bundles:
+                return {"error": "placement group removed"}
             grant = self._try_acquire(resources, strategy)
             if grant is not None:
                 handle = await self.worker_pool.pop_worker(job_id)
@@ -317,27 +337,26 @@ class NodeManager:
                     "lease_id": lease_id,
                 }
 
-            # Can't grant now. Spread tasks and locally-infeasible tasks spill.
-            spill_now = self._pick_spill_node(resources, strategy, require_available=True)
-            local_ok = self._local_feasible(resources, strategy)
-            if strategy.get("type") == "spread" and spill_now is not None:
-                # crude spread: alternate between local queue and remote
-                return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
-                                   "node_id": spill_now["node_id"]}}
-            if not local_ok:
+            if not is_pg:
+                # Can't grant now. Spread tasks and locally-infeasible tasks spill.
+                spill_now = self._pick_spill_node(resources, strategy, require_available=True)
+                local_ok = self._local_feasible(resources, strategy)
+                if strategy.get("type") == "spread" and spill_now is not None:
+                    # crude spread: alternate between local queue and remote
+                    return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
+                                       "node_id": spill_now["node_id"]}}
+                if not local_ok:
+                    if spill_now is not None:
+                        return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
+                                           "node_id": spill_now["node_id"]}}
+                    spill_any = self._pick_spill_node(resources, strategy, require_available=False)
+                    if spill_any is not None:
+                        return {"spill": {"ip": spill_any["ip"], "port": spill_any["raylet_port"],
+                                           "node_id": spill_any["node_id"]}}
+                    return {"error": f"infeasible resource request {resources}"}
                 if spill_now is not None:
                     return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
                                        "node_id": spill_now["node_id"]}}
-                spill_any = self._pick_spill_node(resources, strategy, require_available=False)
-                if spill_any is not None:
-                    return {"spill": {"ip": spill_any["ip"], "port": spill_any["raylet_port"],
-                                       "node_id": spill_any["node_id"]}}
-                if strategy.get("type") == "placement_group":
-                    return {"error": "placement group bundle not on this node"}
-                return {"error": f"infeasible resource request {resources}"}
-            if spill_now is not None:
-                return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
-                                   "node_id": spill_now["node_id"]}}
             # queue locally until resources free up
             waiter = {"event": asyncio.Event()}
             self._lease_waiters.append(waiter)
@@ -358,7 +377,7 @@ class NodeManager:
             handle = self.worker_pool.workers.get(lease["worker_id"])
             if handle is not None:
                 if req.get("kill"):
-                    self.worker_pool.kill_worker(handle)
+                    await self.worker_pool.kill_worker(handle)
                 else:
                     self.worker_pool.push_idle(handle)
         return {"ok": True}
@@ -412,8 +431,9 @@ class NodeManager:
     async def handle_KillWorker(self, req):
         handle = self.worker_pool.workers.get(req["worker_id"])
         if handle is not None:
-            self.worker_pool.kill_worker(handle)
-            await self._on_worker_death(handle)
+            # death is reported once, by the fork server's reap (or the
+            # liveness poll) — not here, to avoid double ReportWorkerDeath
+            await self.worker_pool.kill_worker(handle)
         return {"ok": True}
 
     async def handle_JobFinished(self, req):
@@ -527,8 +547,15 @@ class NodeManager:
         if owner_addr:
             try:
                 owner = await self.pool.get(owner_addr[0], owner_addr[1])
-                status = await owner.call("GetObjectStatus", {"object_id": oid}, timeout=30)
-                locations = list(status.get("locations", []))
+                status = await owner.call(
+                    "GetObjectStatus", {"object_id": oid, "wait": True}, timeout=30
+                )
+                locations = list(status.get("plasma", {}).get("locations", []))
+                if not locations:
+                    logger.warning(
+                        "pull %s: owner reports no plasma locations (status=%s)",
+                        oid.hex()[:12], status.get("status"),
+                    )
             except Exception as e:
                 logger.warning("pull %s: owner unreachable: %s", oid.hex()[:12], e)
                 return False

@@ -198,6 +198,7 @@ class CoreWorker:
         self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
         self._node_cache: Dict[bytes, dict] = {}
         self._node_cache_time = 0.0
+        self._pg_node_cache: Dict[tuple, bytes] = {}  # (pg_id, idx) -> node_id
         self._lineage: Dict[bytes, dict] = {}  # task_id -> spec (for reconstruction)
         self._lineage_bytes = 0
 
@@ -734,7 +735,20 @@ class CoreWorker:
             if not state.queue:
                 return
             sample = state.queue[0]
-            client = raylet_client or self.raylet
+            client = raylet_client
+            if client is None and sample["strategy"].get("type") == "placement_group":
+                # PG tasks lease directly from the raylet holding the bundle
+                # (the local raylet has no view of remote bundle placement).
+                client = await self._pg_raylet(sample["strategy"])
+                if client is None:
+                    err = RuntimeError(
+                        "placement group not found or never became ready"
+                    )
+                    while state.queue:
+                        self._fail_task(state.queue.popleft(), err)
+                    return
+            if client is None:
+                client = self.raylet
             try:
                 reply = await client.call(
                     "RequestWorkerLease",
@@ -775,6 +789,27 @@ class CoreWorker:
             elif reply.get("retry"):
                 state.requests_in_flight += 1
                 asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("retry_pg"):
+                # Bundle not (yet) committed on the raylet we picked: drop the
+                # cached placement and re-resolve from GCS — bounded, so a
+                # commit that never lands fails the task instead of spinning.
+                deadline = sample.setdefault(
+                    "_pg_retry_deadline",
+                    time.time() + RTPU_CONFIG.placement_group_ready_timeout_s,
+                )
+                if time.time() > deadline:
+                    err = RuntimeError(
+                        "placement group bundle never became available"
+                    )
+                    while state.queue:
+                        self._fail_task(state.queue.popleft(), err)
+                    return
+                pg_key = (sample["strategy"]["pg_id"],
+                          sample["strategy"].get("bundle_index") or 0)
+                self._pg_node_cache.pop(pg_key, None)
+                await asyncio.sleep(0.2)
+                state.requests_in_flight += 1
+                asyncio.ensure_future(self._request_lease(key, state))
             elif reply.get("error"):
                 err = RuntimeError(reply["error"])
                 while state.queue:
@@ -782,6 +817,35 @@ class CoreWorker:
                     self._fail_task(spec, err)
         finally:
             state.requests_in_flight -= 1
+
+    async def _pg_raylet(self, strategy: dict):
+        """Resolve the raylet hosting this task's PG bundle, waiting for the
+        group to finish its 2PC if needed. Returns None if the PG is gone."""
+        pg_key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
+        node_id = self._pg_node_cache.get(pg_key)
+        if node_id is None:
+            deadline = time.time() + RTPU_CONFIG.placement_group_ready_timeout_s
+            while time.time() < deadline:
+                reply = await self.gcs_aio.call(
+                    "GetPlacementGroup", {"pg_id": pg_key[0]}
+                )
+                if not reply.get("found"):
+                    return None
+                pg = reply["pg"]
+                if pg["state"] == "CREATED":
+                    node_id = pg["bundles"][pg_key[1]]["node_id"]
+                    break
+                if pg["state"] == "REMOVED":
+                    return None
+                await asyncio.sleep(0.05)
+            if node_id is None:
+                return None
+            self._pg_node_cache[pg_key] = node_id
+        info = await self._node_info(node_id)
+        if info is None:
+            self._pg_node_cache.pop(pg_key, None)
+            return None
+        return await self.pool.get(info["ip"], info["raylet_port"])
 
     async def _push_on_lease(self, key, state: _LeaseState, lease, spec: dict):
         try:

@@ -65,9 +65,10 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: bytes, class_name: str = "Actor"):
+    def __init__(self, actor_id: bytes, class_name: str = "Actor", method_meta: Optional[dict] = None):
         self._actor_id = actor_id
         self._class_name = class_name
+        self._method_meta = method_meta or {}
 
     @property
     def _actor_id_hex(self) -> str:
@@ -76,13 +77,13 @@ class ActorHandle:
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        return ActorMethod(self, item, self._method_meta.get(item, 1))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._class_name))
+        return (ActorHandle, (self._actor_id, self._class_name, self._method_meta))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -137,7 +138,12 @@ class ActorClass:
             scheduling_strategy=strategy_to_dict(o["scheduling_strategy"]),
             runtime_env=o["runtime_env"],
         )
-        return ActorHandle(actor_id, self._cls.__name__)
+        method_meta = {
+            m: getattr(getattr(self._cls, m), "_rtpu_num_returns")
+            for m in dir(self._cls)
+            if hasattr(getattr(self._cls, m, None), "_rtpu_num_returns")
+        }
+        return ActorHandle(actor_id, self._cls.__name__, method_meta)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -149,6 +155,16 @@ class ActorClass:
         from ray_tpu.dag.node import ClassNode
 
         return ClassNode(self, args, kwargs)
+
+
+def method(num_returns: int = 1):
+    """Per-method option decorator (reference: python/ray/actor.py ray.method)."""
+
+    def deco(fn):
+        fn._rtpu_num_returns = num_returns
+        return fn
+
+    return deco
 
 
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:

@@ -35,6 +35,9 @@ SchedulingStrategyT = Union[
     NodeLabelSchedulingStrategy,
 ]
 
+# per-PG round-robin cursor for bundle_index=-1 ("any bundle") submissions
+_rr_counters: dict = {}
+
 
 def strategy_to_dict(strategy: SchedulingStrategyT) -> dict:
     if strategy is None or strategy == "DEFAULT":
@@ -48,11 +51,18 @@ def strategy_to_dict(strategy: SchedulingStrategyT) -> dict:
         return {"type": "node_affinity", "node_id": node_id, "soft": strategy.soft}
     if isinstance(strategy, PlacementGroupSchedulingStrategy):
         pg = strategy.placement_group
+        pg_id = pg.id if isinstance(pg.id, bytes) else pg.id.binary()
         index = strategy.placement_group_bundle_index
+        if index < 0:
+            # "any bundle": round-robin across the group's bundles per
+            # submission so tasks spread instead of pinning to bundle 0
+            n = max(pg.bundle_count, 1)
+            index = _rr_counters.get(pg_id, 0) % n
+            _rr_counters[pg_id] = index + 1
         return {
             "type": "placement_group",
-            "pg_id": pg.id if isinstance(pg.id, bytes) else pg.id.binary(),
-            "bundle_index": None if index < 0 else index,
+            "pg_id": pg_id,
+            "bundle_index": index,
         }
     if isinstance(strategy, NodeLabelSchedulingStrategy):
         return {"type": "node_label", "hard": strategy.hard, "soft": strategy.soft}

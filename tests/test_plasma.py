@@ -1,0 +1,132 @@
+"""Native shared-memory store unit tests
+(modeled on reference src/ray/object_manager/plasma/test/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
+
+
+@pytest.fixture
+def store():
+    name = f"/rtpu_test_{os.getpid()}_{os.urandom(4).hex()}"
+    client = PlasmaClient(name, capacity=32 * 1024 * 1024, create=True)
+    yield client
+    client.close(unmap=True)
+    PlasmaClient.unlink(name)
+
+
+def _oid():
+    return os.urandom(20)
+
+
+def test_create_seal_get(store):
+    oid = _oid()
+    data = np.arange(1000, dtype=np.int64)
+    buf = store.create(oid, data.nbytes)
+    np.frombuffer(buf, dtype=np.int64)[:] = data
+    buf.release()
+    store.seal(oid)
+    view = store.get(oid)
+    assert np.array_equal(np.frombuffer(view, dtype=np.int64), data)
+    view.release()
+    store.release(oid)
+
+
+def test_get_missing(store):
+    assert store.get(_oid()) is None
+    assert not store.contains(_oid())
+
+
+def test_unsealed_not_gettable(store):
+    oid = _oid()
+    buf = store.create(oid, 100)
+    buf.release()
+    assert store.get(oid) is None
+    store.abort(oid)
+
+
+def test_double_create_rejected(store):
+    oid = _oid()
+    b = store.create(oid, 10)
+    b.release()
+    store.seal(oid)
+    with pytest.raises(FileExistsError):
+        store.create(oid, 10)
+
+
+def test_delete_frees_space(store):
+    oid = _oid()
+    assert store.put_blob(oid, b"x" * 1_000_000)
+    used_before = store.stats()["used_bytes"]
+    assert store.delete(oid)
+    assert store.stats()["used_bytes"] < used_before
+    assert not store.contains(oid)
+
+
+def test_pending_delete_deferred_while_pinned(store):
+    oid = _oid()
+    store.put_blob(oid, b"y" * 1000)
+    view = store.get(oid)  # pins
+    assert not store.delete(oid)  # deferred
+    assert bytes(view[:4]) == b"yyyy"  # data still valid under the view
+    view.release()
+    store.release(oid)  # last unpin reclaims
+    assert not store.contains(oid)
+
+
+def test_lru_eviction_under_pressure(store):
+    for _ in range(40):
+        assert store.put_blob(_oid(), b"z" * (2 * 1024 * 1024))
+    stats = store.stats()
+    assert stats["evicted_count"] > 0
+    assert stats["used_bytes"] <= stats["capacity_bytes"]
+
+
+def test_pinned_objects_survive_eviction(store):
+    oid = _oid()
+    store.put_blob(oid, b"k" * 1024)
+    view = store.get(oid)  # pin
+    for _ in range(40):
+        store.put_blob(_oid(), b"z" * (2 * 1024 * 1024))
+    assert store.contains(oid)
+    assert bytes(view[:4]) == b"kkkk"
+    view.release()
+    store.release(oid)
+
+
+def test_oom_when_everything_pinned(store):
+    oid = _oid()
+    store.put_blob(oid, b"a" * (30 * 1024 * 1024))
+    view = store.get(oid)
+    with pytest.raises(PlasmaOOM):
+        store.create(_oid(), 30 * 1024 * 1024)
+    view.release()
+    store.release(oid)
+
+
+def test_cross_client_visibility(store):
+    other = PlasmaClient(store.name)
+    oid = _oid()
+    store.put_blob(oid, b"shared")
+    view = other.get(oid)
+    assert bytes(view) == b"shared"
+    view.release()
+    other.release(oid)
+    other.close()
+
+
+def test_free_list_coalescing(store):
+    # fill, delete all, then a single allocation of most of the arena must fit
+    oids = [_oid() for _ in range(10)]
+    for oid in oids:
+        store.put_blob(oid, b"c" * (2 * 1024 * 1024))
+    for oid in oids:
+        store.delete(oid)
+    big = _oid()
+    buf = store.create(big, 24 * 1024 * 1024)
+    buf.release()
+    store.seal(big)
+    assert store.contains(big)

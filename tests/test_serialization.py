@@ -1,0 +1,70 @@
+"""Serialization-layer unit tests (no cluster needed)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+def roundtrip(value):
+    p, bufs, refs = serialization.serialize(value)
+    out, out_refs = serialization.deserialize(p, bufs)
+    return out
+
+
+def test_primitives():
+    for v in [1, 2.5, "s", b"b", None, True, [1, 2], {"a": 1}, (1, 2), {1, 2}]:
+        assert roundtrip(v) == v
+
+
+def test_numpy_out_of_band():
+    arr = np.random.rand(100, 100)
+    p, bufs, _ = serialization.serialize(arr)
+    assert len(bufs) >= 1  # buffer went out-of-band, not into the pickle
+    out, _ = serialization.deserialize(p, bufs)
+    assert np.array_equal(out, arr)
+
+
+def test_object_ref_capture():
+    ref = ObjectRef(ObjectID.from_random(), ("127.0.0.1", 1234), skip_refcount=True)
+    p, bufs, refs = serialization.serialize({"nested": [ref]})
+    assert refs == [ref]
+    out, out_refs = serialization.deserialize(p, bufs)
+    assert out["nested"][0] == ref
+    assert out_refs[0] == ref
+    assert out_refs[0].owner_address == ("127.0.0.1", 1234)
+
+
+def test_blob_roundtrip():
+    value = {"arr": np.arange(10000), "meta": "x"}
+    blob = serialization.serialize_to_blob(value)
+    out, _ = serialization.read_blob(memoryview(blob))
+    assert np.array_equal(out["arr"], value["arr"])
+    assert out["meta"] == "x"
+
+
+def test_blob_buffer_alignment():
+    arr = np.arange(1000, dtype=np.float64)
+    p, bufs, _ = serialization.serialize(arr)
+    blob = bytearray(serialization.blob_size(p, bufs))
+    serialization.write_blob(memoryview(blob), p, bufs)
+    out, _ = serialization.read_blob(memoryview(bytes(blob)))
+    assert np.array_equal(out, arr)
+
+
+def test_closure_function():
+    x = 42
+
+    def f(y):
+        return x + y
+
+    g = roundtrip(f)
+    assert g(1) == 43
+
+
+def test_inline_roundtrip():
+    msg, refs = serialization.serialize_inline([1, np.ones(5)])
+    out, _ = serialization.deserialize_inline(msg)
+    assert out[0] == 1 and np.array_equal(out[1], np.ones(5))
