@@ -1,0 +1,102 @@
+"""Benchmark: GPT-2-124M training throughput through the framework's sharded
+train step vs a hand-written raw-jax loop on the same hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is framework-tokens/s divided by raw-jax tokens/s on this chip —
+the BASELINE.json north star asks for >= 0.90.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models.gpt2 import GPT2, GPT2Config, loss_fn
+from ray_tpu.parallel.mesh import make_mesh
+from ray_tpu.parallel.train_step import TrainStep
+
+WARMUP = 3
+STEPS = 10
+
+
+def _batch(cfg, B, T, rng):
+    idx = rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+    return {"idx": idx, "targets": np.roll(idx, -1, axis=1)}
+
+
+def bench_framework(cfg, B, T) -> float:
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    ts = TrainStep(cfg, mesh)
+    state = ts.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = ts.shard_batch(_batch(cfg, B, T, rng))
+    for _ in range(WARMUP):
+        state, m = ts.step(state, batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = ts.step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return B * T * STEPS / dt
+
+
+def bench_raw_jax(cfg, B, T) -> float:
+    """The 'no framework' control: plain jit train step, same model/opt."""
+    model = GPT2(cfg)
+    opt = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(3e-4, b2=0.95, weight_decay=0.1,
+                    mask=lambda p: jax.tree.map(lambda x: x.ndim > 1, p)),
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, idx, targets):
+        def loss_of(p):
+            return loss_fn(model.apply({"params": p}, idx), targets)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    rng = np.random.default_rng(0)
+    b = _batch(cfg, B, T, rng)
+    idx, tgt = jnp.asarray(b["idx"]), jnp.asarray(b["targets"])
+    for _ in range(WARMUP):
+        params, opt_state, loss = step(params, opt_state, idx, tgt)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, idx, tgt)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return B * T * STEPS / dt
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = GPT2Config.gpt2_124m() if on_tpu else GPT2Config(
+        vocab_size=2048, block_size=256, n_layer=4, n_head=8, n_embd=256,
+        dtype=jnp.float32, use_flash_attention=False,
+    )
+    B, T = (8, 1024) if on_tpu else (4, 256)
+    ours = bench_framework(cfg, B, T)
+    raw = bench_raw_jax(cfg, B, T)
+    print(json.dumps({
+        "metric": "gpt2_train_tokens_per_s_single_chip",
+        "value": round(ours, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(ours / raw, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,10 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     use_flash_attention: bool = True
+    # Override the attention primitive, e.g. a shard_map-wrapped ring
+    # attention bound to a mesh (ray_tpu/parallel/train_step.py). Signature
+    # (q, k, v) -> out, all (B, T, H, D).
+    attn_fn: Any = None
 
     @classmethod
     def gpt2_124m(cls, **kw):
@@ -68,7 +72,9 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, cfg.n_head, head_dim)
         v = v.reshape(B, T, cfg.n_head, head_dim)
 
-        if cfg.use_flash_attention:
+        if cfg.attn_fn is not None:
+            y = cfg.attn_fn(q, k, v)
+        elif cfg.use_flash_attention:
             from ray_tpu.ops.attention import causal_attention
 
             y = causal_attention(q, k, v)

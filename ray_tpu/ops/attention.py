@@ -73,7 +73,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q, block_k,
     num_k_blocks = (qi + 1) * block_q // block_k  # causal: only blocks at/below diag
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m, l, acc))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse rides a (bh, 1, t) layout: block (1, 1, block_q) keeps Mosaic's
+    # last-two-dims tiling rule satisfied (a (1, block_q) rank-2 block is not)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
@@ -93,11 +95,11 @@ def _flash_fwd(q, k, v, *, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -114,8 +116,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
     d = q.shape[-1]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
@@ -153,8 +155,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -189,7 +191,9 @@ def _flash_bwd(res, g, *, block_q, block_k, interpret):
     do = g
     bh, t, d = q.shape
     scale = 1.0 / math.sqrt(d)
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (bh, t)
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )[:, None, :]  # (bh, 1, t) — same layout as lse
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k),
@@ -199,8 +203,8 @@ def _flash_bwd(res, g, *, block_q, block_k, interpret):
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
@@ -217,8 +221,8 @@ def _flash_bwd(res, g, *, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, t), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),

@@ -99,7 +99,7 @@ def ring_causal_attention(q, k, v, axis_name: str = "sp"):
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
                            batch_axes=("dp", "fsdp")):
     """Global-array convenience wrapper: shard_map over the sequence axis."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     data = tuple(a for a in batch_axes if a in mesh.axis_names)
     spec = P(data if data else None, axis_name, None, None)
@@ -108,6 +108,6 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)

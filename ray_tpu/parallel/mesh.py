@@ -116,13 +116,22 @@ def filter_spec_for_mesh(spec: P, mesh: Mesh) -> P:
     return P(*(keep(e) for e in spec))
 
 
+def filtered_tree_specs(rules: ShardingRules, tree, mesh: Mesh):
+    """Rule-derived PartitionSpecs with axes the mesh lacks dropped."""
+    specs = rules.tree_specs(tree)
+    return jax.tree.map(lambda s: filter_spec_for_mesh(s, mesh), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def filtered_tree_shardings(rules: ShardingRules, tree, mesh: Mesh):
+    specs = filtered_tree_specs(rules, tree, mesh)
+    return specs, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                               is_leaf=lambda x: isinstance(x, P))
+
+
 def shard_tree(tree, mesh: Mesh, rules: ShardingRules):
     """device_put a pytree with rule-derived (mesh-filtered) shardings."""
-    specs = rules.tree_specs(tree)
-    specs = jax.tree.map(lambda s: filter_spec_for_mesh(s, mesh), specs,
-                         is_leaf=lambda x: isinstance(x, P))
-    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                             is_leaf=lambda x: isinstance(x, P))
+    _, shardings = filtered_tree_shardings(rules, tree, mesh)
     return jax.device_put(tree, shardings), shardings
 
 

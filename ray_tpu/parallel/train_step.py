@@ -1,0 +1,158 @@
+"""Sharded training step builder: one jit, every parallelism axis.
+
+This is the TPU-native replacement for the reference's torch DDP/FSDP wrapper
+stack (reference: python/ray/train/torch/train_loop_utils.py:453 prepare_model
+→ DDP, :184 FSDP): instead of wrapping modules and calling NCCL imperatively,
+we build a `jax.sharding.Mesh`, assign PartitionSpecs to params/optimizer
+state/batch, and compile ONE train step under jit — XLA inserts the ICI
+collectives (grad psums over dp, param all-gathers over fsdp, activation
+collectives over tp, ring ppermutes over sp) from the shardings.
+
+Axes (any subset may be trivial/size-1, one rule set serves all):
+  dp    batch;                 grads psum over it (DDP-equivalent)
+  fsdp  param/optimizer shard; ZeRO-3-equivalent, also carries batch
+  tp    Megatron tensor parallel over hidden/head dims
+  sp    sequence/context parallel; attention runs a ppermute ring
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.models.gpt2 import (
+    GPT2,
+    GPT2Config,
+    GPT2_SHARDING_RULES,
+    loss_fn,
+)
+from ray_tpu.parallel.mesh import (
+    ShardingRules,
+    batch_sharding,
+    filtered_tree_shardings,
+)
+
+
+def _ring_attn_for_mesh(mesh: Mesh, seq_axis: str = "sp"):
+    """Attention callable for GPT2Config.attn_fn: ring attention over the
+    sequence axis via shard_map, local flash attention per chunk-pair."""
+    from jax import shard_map
+
+    from ray_tpu.ops.ring_attention import ring_causal_attention
+
+    data = tuple(
+        a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+    spec = P(data if data else None, seq_axis, tp, None)  # (B, T, H, D)
+
+    fn = shard_map(
+        functools.partial(ring_causal_attention, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn
+
+
+def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]) -> GPT2:
+    """Instantiate GPT2 wired for this mesh: ring attention iff sp > 1."""
+    import dataclasses
+
+    if (
+        mesh is not None
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
+    ):
+        cfg = dataclasses.replace(cfg, attn_fn=_ring_attn_for_mesh(mesh))
+    return GPT2(cfg)
+
+
+class TrainStep:
+    """Compiled (init, step) pair with sharded state.
+
+    Usage:
+        ts = TrainStep(GPT2Config.tiny(), mesh)
+        state = ts.init(jax.random.PRNGKey(0))
+        state, metrics = ts.step(state, batch)   # batch: dict idx/targets (B, T)
+    """
+
+    def __init__(
+        self,
+        model_cfg: GPT2Config,
+        mesh: Mesh,
+        *,
+        learning_rate: float = 3e-4,
+        weight_decay: float = 0.1,
+        beta2: float = 0.95,
+        grad_clip: float = 1.0,
+        rules: ShardingRules = GPT2_SHARDING_RULES,
+    ):
+        self.model_cfg = model_cfg
+        self.mesh = mesh
+        self.model = gpt2_model_for_mesh(model_cfg, mesh)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adamw(
+                learning_rate, b2=beta2, weight_decay=weight_decay,
+                mask=lambda params: jax.tree.map(lambda p: p.ndim > 1, params),
+            ),
+        )
+        self.batch_sharding = batch_sharding(mesh)
+
+        def init_fn(rng):
+            T = min(8, model_cfg.block_size)
+            idx = jnp.zeros((2, T), dtype=jnp.int32)
+            params = GPT2(model_cfg).init(rng, idx)["params"]
+            return {
+                "params": params,
+                "opt_state": self.optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+
+        state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        self.state_specs, self.state_shardings = filtered_tree_shardings(
+            rules, state_shape, mesh
+        )
+        self._init = jax.jit(init_fn, out_shardings=self.state_shardings)
+
+        def step_fn(state, batch):
+            def loss_of(params):
+                logits = self.model.apply({"params": params}, batch["idx"])
+                return loss_fn(logits, batch["targets"])
+
+            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            updates, opt_state = self.optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            }
+            gnorm = optax.global_norm(grads)
+            return new_state, {"loss": loss, "grad_norm": gnorm}
+
+        self._step = jax.jit(
+            step_fn,
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    def init(self, rng) -> Dict[str, Any]:
+        with self.mesh:
+            return self._init(rng)
+
+    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.device_put(batch, self.batch_sharding)
+
+    def step(self, state, batch) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        with self.mesh:
+            return self._step(state, batch)

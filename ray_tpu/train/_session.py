@@ -1,0 +1,105 @@
+"""Per-worker training session: rank info + report() channel back to the
+trainer (reference: train/_internal/session.py:111 _TrainSession, report
+:667). The user loop runs on a thread inside the worker actor; report() blocks
+until the driver has consumed the report, which gives the same per-report
+barrier semantics as the reference."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, node_ip: str,
+                 experiment_name: str = ""):
+        self._world_rank = world_rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self._local_world_size = local_world_size
+        self._node_ip = node_ip
+        self._experiment_name = experiment_name
+
+    def get_world_rank(self) -> int:
+        return self._world_rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._local_world_size
+
+    def get_node_ip(self) -> str:
+        return self._node_ip
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+
+class _Session:
+    def __init__(self, ctx: TrainContext, latest_checkpoint: Optional[Checkpoint]):
+        self.ctx = ctx
+        self.latest_checkpoint = latest_checkpoint
+        self.reports: "queue.Queue" = queue.Queue()
+        self.consumed = threading.Event()
+        self.finished = False
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        self.consumed.clear()
+        self.reports.put({"metrics": metrics, "checkpoint": checkpoint})
+        # Block the training thread until the driver consumed the report —
+        # keeps workers in lockstep per report like the reference session.
+        self.consumed.wait()
+
+
+_session: Optional[_Session] = None
+_session_lock = threading.Lock()
+
+
+def init_session(ctx: TrainContext, checkpoint: Optional[Checkpoint]) -> _Session:
+    global _session
+    with _session_lock:
+        _session = _Session(ctx, checkpoint)
+        return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_session() -> Optional[_Session]:
+    return _session
+
+
+# ------------------------------------------------------------- public API
+
+
+def get_context() -> TrainContext:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.get_context() outside a train worker")
+    return s.ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() outside a train worker")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = get_session()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.get_checkpoint() outside a train worker")
+    return s.latest_checkpoint

@@ -1,0 +1,275 @@
+"""DataParallelTrainer / JaxTrainer: drive a worker group through a training
+run with report/checkpoint rounds and group-restart fault tolerance.
+
+Reference call stack (SURVEY.md §3.4): TorchTrainer.fit →
+BackendExecutor.start → WorkerGroup actors → _setup_torch_process_group →
+start_training → poll reports (train/base_trainer.py:567,
+_internal/backend_executor.py:67/:445, data_parallel_trainer.py:428). Here the
+process-group setup is `jax.distributed.initialize` and the data plane is the
+XLA-compiled sharded step, not NCCL."""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._session import TrainContext
+from ray_tpu.train._worker_group import WorkerGroup
+
+logger = logging.getLogger("ray_tpu.train")
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class Result:
+    def __init__(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint],
+                 path: str, error: Optional[Exception] = None,
+                 metrics_history: Optional[List[Dict[str, Any]]] = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.path = path
+        self.error = error
+        self.metrics_history = metrics_history or []
+
+    def __repr__(self):
+        return (f"Result(metrics={self.metrics!r}, "
+                f"checkpoint={self.checkpoint!r}, error={self.error!r})")
+
+
+class DataParallelTrainer:
+    """SPMD function trainer: run `train_loop_per_worker` on every worker.
+
+    Subclasses configure the worker runtime (JaxTrainer wires jax.distributed
+    + env); the base class owns scheduling, report rounds, checkpoint
+    persistence and group restarts."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self._resume_checkpoint = resume_from_checkpoint
+        name = self.run_config.name or f"train_{int(time.time())}"
+        storage = self.run_config.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results"
+        )
+        self.experiment_dir = os.path.join(storage, name)
+
+    # ------------------------------------------------------------ backend hooks
+
+    def _worker_env(self) -> Dict[str, str]:
+        return {}
+
+    def _on_group_start(self, group: WorkerGroup):
+        """Backend setup after actors exist, before the user loop starts."""
+
+    # ------------------------------------------------------------------- fit
+
+    def fit(self) -> Result:
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        failure_config = self.run_config.failure_config or FailureConfig()
+        ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
+        retries_left = failure_config.max_failures
+        latest_checkpoint = self._resume_checkpoint
+        while True:
+            try:
+                return self._fit_once(latest_checkpoint, ckpt_config)
+            except TrainingFailedError:
+                raise
+            except Exception as e:
+                # group failure (worker/actor death) — restart from the last
+                # persisted checkpoint (reference: FailureConfig(max_failures),
+                # whole-group restart, air/config.py:395)
+                latest_checkpoint = self._latest_persisted_checkpoint()
+                if retries_left == 0:
+                    raise TrainingFailedError(
+                        f"training failed with no retries left: {e}"
+                    ) from e
+                retries_left -= 1
+                logger.warning(
+                    "worker group failed (%s); restarting from %s "
+                    "(%d retries left)", e, latest_checkpoint, retries_left,
+                )
+
+    def _fit_once(self, checkpoint: Optional[Checkpoint],
+                  ckpt_config: CheckpointConfig) -> Result:
+        sc = self.scaling_config
+        group = WorkerGroup(
+            sc.num_workers,
+            sc.worker_resources(),
+            placement_strategy=sc.placement_strategy,
+            env=self._worker_env(),
+        )
+        try:
+            self._on_group_start(group)
+            ips = group.execute("node_ip")
+            local_ranks = self._local_ranks(ips)
+            per_worker = []
+            for rank in range(sc.num_workers):
+                ctx = TrainContext(
+                    world_rank=rank,
+                    world_size=sc.num_workers,
+                    local_rank=local_ranks[rank],
+                    local_world_size=ips.count(ips[rank]) if ips else 1,
+                    node_ip=ips[rank],
+                    experiment_name=os.path.basename(self.experiment_dir),
+                )
+                per_worker.append(
+                    (self._train_fn, self._train_config, ctx, checkpoint)
+                )
+            group.execute("start_run", per_worker_args=per_worker)
+            return self._poll_reports(group, ckpt_config)
+        finally:
+            group.shutdown()
+
+    def _local_ranks(self, ips: List[str]) -> List[int]:
+        counters: Dict[str, int] = {}
+        out = []
+        for ip in ips:
+            out.append(counters.get(ip, 0))
+            counters[ip] = out[-1] + 1
+        return out
+
+    def _poll_reports(self, group: WorkerGroup,
+                      ckpt_config: CheckpointConfig) -> Result:
+        import ray_tpu
+
+        metrics_history: List[Dict[str, Any]] = []
+        last_metrics: Dict[str, Any] = {}
+        result_checkpoint: Optional[Checkpoint] = None
+        # Continue numbering after any checkpoints a previous (crashed)
+        # attempt persisted, so restarts never overwrite newer state.
+        existing = [
+            d for d in os.listdir(self.experiment_dir)
+            if d.startswith("checkpoint_")
+        ] if os.path.isdir(self.experiment_dir) else []
+        ckpt_index = (
+            max(int(d.split("_")[-1]) for d in existing) + 1 if existing else 0
+        )
+        active = list(range(group.num_workers))
+        saved: List[tuple] = []  # (score, path)
+        while active:
+            refs = [group.async_call(i, "next_report") for i in active]
+            reports = dict(zip(list(active), ray_tpu.get(refs)))
+            for i, rep in reports.items():
+                if rep["type"] == "error":
+                    raise TrainingFailedError(
+                        f"worker {i} failed:\n{rep['traceback'] or rep['error']}"
+                    )
+                if rep["type"] == "finished":
+                    active.remove(i)
+            reports = {i: r for i, r in reports.items() if r["type"] == "report"}
+            if reports:
+                # rank-0 metrics win; lowest reporting rank if 0 has finished
+                lead = reports[min(reports)]["metrics"]
+                last_metrics = lead
+                metrics_history.append(lead)
+                ckpt_path = next(
+                    (r["checkpoint_path"] for r in reports.values()
+                     if "checkpoint_path" in r), None,
+                )
+                if ckpt_path:
+                    dest = os.path.join(
+                        self.experiment_dir, f"checkpoint_{ckpt_index:06d}"
+                    )
+                    ckpt_index += 1
+                    shutil.copytree(ckpt_path, dest, dirs_exist_ok=True)
+                    attr = ckpt_config.checkpoint_score_attribute
+                    score = lead.get(attr, 0.0) if attr else None
+                    saved.append((score, dest))
+                    result_checkpoint = Checkpoint(dest)
+                    if (ckpt_config.num_to_keep
+                            and len(saved) > ckpt_config.num_to_keep):
+                        if attr:
+                            # drop the worst-scoring checkpoint
+                            sign = (1 if ckpt_config.checkpoint_score_order
+                                    == "max" else -1)
+                            worst = min(
+                                range(len(saved)),
+                                key=lambda j: sign * saved[j][0],
+                            )
+                        else:
+                            worst = 0  # FIFO
+                        _, drop = saved.pop(worst)
+                        shutil.rmtree(drop, ignore_errors=True)
+                        if result_checkpoint.path == drop:
+                            result_checkpoint = Checkpoint(saved[-1][1])
+                for i in active:
+                    group.async_call(i, "ack_report")
+        return Result(
+            metrics=last_metrics,
+            checkpoint=result_checkpoint,
+            path=self.experiment_dir,
+            metrics_history=metrics_history,
+        )
+
+    def _latest_persisted_checkpoint(self) -> Optional[Checkpoint]:
+        if not os.path.isdir(self.experiment_dir):
+            return None
+        ckpts = sorted(
+            d for d in os.listdir(self.experiment_dir)
+            if d.startswith("checkpoint_")
+        )
+        if not ckpts:
+            return self._resume_checkpoint
+        return Checkpoint(os.path.join(self.experiment_dir, ckpts[-1]))
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Trainer whose workers form one jax SPMD world.
+
+    - one worker per TPU host (or per slice via ScalingConfig.topology);
+    - with >1 worker and jax_config.distributed, rank 0 hosts the jax
+      coordinator and every worker runs jax.distributed.initialize — the
+      global mesh then spans hosts, collectives ride ICI/DCN;
+    - the reference's closest analogue is TorchXLAConfig
+      (train/torch/xla/config.py:20) which only supported AWS Neuron; this is
+      the real TPU path."""
+
+    def __init__(self, *args, jax_config: Optional[JaxConfig] = None, **kw):
+        super().__init__(*args, **kw)
+        self.jax_config = jax_config or JaxConfig()
+
+    def _worker_env(self) -> Dict[str, str]:
+        return dict(self.jax_config.env)
+
+    def _on_group_start(self, group: WorkerGroup):
+        jc = self.jax_config
+        distributed = jc.distributed
+        if distributed is None:
+            distributed = group.num_workers > 1
+        if not distributed:
+            return
+        ip = group.execute_single(0, "node_ip")
+        port = jc.coordinator_port or group.execute_single(0, "free_port")
+        coordinator = f"{ip}:{port}"
+        refs = [
+            group.async_call(i, "init_jax_distributed", coordinator,
+                             group.num_workers, i)
+            for i in range(group.num_workers)
+        ]
+        import ray_tpu
+
+        counts = ray_tpu.get(refs, timeout=120)
+        logger.info("jax.distributed up: %s global devices", counts[0])

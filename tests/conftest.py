@@ -7,10 +7,19 @@ before jax is first imported anywhere in the process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient env may point JAX_PLATFORMS at real TPU hardware,
+# but tests must run chip-free on the virtual 8-device mesh (SURVEY.md §4).
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
 )
+
+# A pytest plugin may have imported jax before this file ran, baking the
+# ambient JAX_PLATFORMS into its config; override it (backends are lazy, so
+# this works as long as no array has touched a device yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
