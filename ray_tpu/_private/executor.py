@@ -126,10 +126,19 @@ class Executor:
         if not fut.done():
             fut.set_result(reply)
 
+
+    def _actor_method(self, method_name):
+        """Resolve an actor method; `__ray_call__` runs an arbitrary function
+        against the instance (reference: actor.__ray_call__.remote(fn))."""
+        if method_name == "__ray_call__":
+            inst = self.actor_instance
+            return lambda fn, *a, **kw: fn(inst, *a, **kw)
+        return getattr(self.actor_instance, method_name)
+
     async def _execute_async_actor(self, spec: dict) -> dict:
         method_name = spec["method_name"]
         args, kwargs, pins = await self._resolve_args(spec)
-        method = getattr(self.actor_instance, method_name)
+        method = self._actor_method(method_name)
         outer = asyncio.get_running_loop()
         result_fut = outer.create_future()
 
@@ -200,7 +209,7 @@ class Executor:
         loop = asyncio.get_running_loop()
         try:
             if spec["type"] == TASK_ACTOR:
-                fn = getattr(self.actor_instance, spec["method_name"])
+                fn = self._actor_method(spec["method_name"])
             else:
                 fn = await loop.run_in_executor(
                     None, self.core.functions.fetch, spec["fn_key"]

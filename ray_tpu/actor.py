@@ -75,6 +75,10 @@ class ActorHandle:
         return self._actor_id.hex()
 
     def __getattr__(self, item):
+        if item == "__ray_call__":
+            # run an arbitrary fn against the actor instance:
+            # handle.__ray_call__.remote(lambda self, ...: ...)
+            return ActorMethod(self, item, 1)
         if item.startswith("_"):
             raise AttributeError(item)
         return ActorMethod(self, item, self._method_meta.get(item, 1))

@@ -1,0 +1,31 @@
+"""ray_tpu.util — placement groups, scheduling strategies, collectives,
+actor pool, queue, state API."""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu.util import collective  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "collective":
+        from ray_tpu.util import collective
+
+        return collective
+    if name == "placement_group":
+        from ray_tpu.util import placement_group
+
+        return placement_group
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+
+        return ActorPool
+    if name == "queue":
+        from ray_tpu.util import queue
+
+        return queue
+    if name == "state":
+        from ray_tpu.util import state
+
+        return state
+    raise AttributeError(f"module 'ray_tpu.util' has no attribute '{name}'")

@@ -1,0 +1,128 @@
+"""Collective group tests (modeled on reference
+util/collective/tests/single_node_cpu_tests/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@ray_tpu.remote
+class Worker:
+    def init(self, world, rank, group="default"):
+        col.init_collective_group(world, rank, group_name=group)
+        return rank
+
+    def allreduce(self, x, group="default", op=col.ReduceOp.SUM):
+        return col.allreduce(np.asarray(x, dtype=np.float32), group, op=op)
+
+    def allgather(self, x, group="default"):
+        return col.allgather(np.asarray(x, dtype=np.float32), group)
+
+    def broadcast(self, x, src, group="default"):
+        return col.broadcast(np.asarray(x, dtype=np.float32), src, group)
+
+    def reducescatter(self, x, group="default"):
+        return col.reducescatter(np.asarray(x, dtype=np.float32), group)
+
+    def rank_info(self, group="default"):
+        return (col.get_rank(group), col.get_collective_group_size(group))
+
+    def p2p(self, peer, group="default"):
+        r = col.get_rank(group)
+        if r == 0:
+            col.send(np.arange(5, dtype=np.int64) * 7, peer, group)
+            return None
+        return col.recv(0, group)
+
+    def barrier(self, group="default"):
+        col.barrier(group)
+        return True
+
+    def destroy(self, group="default"):
+        col.destroy_collective_group(group)
+        return True
+
+
+@pytest.fixture(scope="module")
+def world4():
+    ray_tpu.init(num_cpus=6)
+    workers = [Worker.remote() for _ in range(4)]
+    ray_tpu.get([w.init.remote(4, i) for i, w in enumerate(workers)])
+    yield workers
+    ray_tpu.shutdown()
+
+
+def test_rank_info(world4):
+    infos = ray_tpu.get([w.rank_info.remote() for w in world4])
+    assert infos == [(0, 4), (1, 4), (2, 4), (3, 4)]
+
+
+def test_allreduce_sum(world4):
+    data = [np.full(10, i + 1, np.float32) for i in range(4)]
+    out = ray_tpu.get(
+        [w.allreduce.remote(d) for w, d in zip(world4, data)]
+    )
+    for o in out:
+        np.testing.assert_array_equal(o, np.full(10, 10.0, np.float32))
+
+
+def test_allreduce_max(world4):
+    data = [np.arange(8, dtype=np.float32) * (i + 1) for i in range(4)]
+    out = ray_tpu.get(
+        [w.allreduce.remote(d, "default", col.ReduceOp.MAX)
+         for w, d in zip(world4, data)]
+    )
+    for o in out:
+        np.testing.assert_array_equal(o, np.arange(8, dtype=np.float32) * 4)
+
+
+def test_allgather(world4):
+    out = ray_tpu.get(
+        [w.allgather.remote(np.full(3, i, np.float32))
+         for i, w in enumerate(world4)]
+    )
+    for gathered in out:
+        assert len(gathered) == 4
+        for i, g in enumerate(gathered):
+            np.testing.assert_array_equal(g, np.full(3, i, np.float32))
+
+
+def test_broadcast(world4):
+    payload = np.arange(6, dtype=np.float32)
+    out = ray_tpu.get(
+        [w.broadcast.remote(payload if i == 1 else np.zeros(6), 1)
+         for i, w in enumerate(world4)]
+    )
+    for o in out:
+        np.testing.assert_array_equal(o, payload)
+
+
+def test_reducescatter(world4):
+    data = np.arange(8, dtype=np.float32)
+    out = ray_tpu.get([w.reducescatter.remote(data) for w in world4])
+    full = data * 4
+    got = np.concatenate([out[(r + 1) % 4] for r in range(4)])
+    # every element of the reduced vector appears exactly once across ranks
+    np.testing.assert_array_equal(np.sort(got), np.sort(full))
+
+
+def test_send_recv(world4):
+    res = ray_tpu.get([world4[0].p2p.remote(1), world4[1].p2p.remote(1)])
+    np.testing.assert_array_equal(res[1], np.arange(5, dtype=np.int64) * 7)
+
+
+def test_barrier(world4):
+    assert all(ray_tpu.get([w.barrier.remote() for w in world4]))
+
+
+def test_create_collective_group_declarative(world4):
+    workers = [Worker.remote() for _ in range(2)]
+    col.create_collective_group(workers, 2, [0, 1], group_name="g2")
+    out = ray_tpu.get(
+        [w.allreduce.remote(np.ones(4), "g2") for w in workers]
+    )
+    for o in out:
+        np.testing.assert_array_equal(o, np.full(4, 2.0, np.float32))
+    ray_tpu.get([w.destroy.remote("g2") for w in workers])
