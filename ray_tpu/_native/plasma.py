@@ -93,6 +93,10 @@ def _load():
     lib.ps_stats.argtypes = [ctypes.c_void_p, u64p, u64p, u64p, u64p, u64p]
     lib.ps_list.restype = ctypes.c_uint64
     lib.ps_list.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+    lib.ps_test_lock.argtypes = [ctypes.c_void_p]
+    lib.ps_recovered_count.restype = ctypes.c_uint64
+    lib.ps_recovered_count.argtypes = [ctypes.c_void_p]
+    lib.ps_poisoned.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -214,6 +218,20 @@ class PlasmaClient:
             "evicted_bytes": ev_b.value,
             "evicted_count": ev_c.value,
         }
+
+    def recovered_count(self) -> int:
+        """Owner-death free-list rebuilds performed on this store."""
+        return self._libref.ps_recovered_count(self._handle)
+
+    def poisoned(self) -> bool:
+        """True if unrecoverable corruption was detected; all ops fail."""
+        return bool(self._libref.ps_poisoned(self._handle))
+
+    def _test_lock_and_abandon(self):
+        """Test-only: take the store mutex and never release it. The calling
+        process is expected to exit, triggering EOWNERDEAD recovery in the
+        next locker."""
+        self._libref.ps_test_lock(self._handle)
 
     def close(self, unmap: bool = False):
         """Detach from the store.

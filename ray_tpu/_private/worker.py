@@ -1062,13 +1062,31 @@ class CoreWorker:
     async def _push_actor_task(self, sub: _ActorSubmitter, spec: dict):
         sub.inflight[spec["task_id"]] = spec
         try:
-            client = await self.pool.get(*sub.addr)
+            try:
+                client = await self.pool.get(*sub.addr)
+            except (ConnectionLost, OSError):
+                # Connection never established: the task provably did not
+                # execute, so it is safe to buffer for the restarted actor.
+                sub.inflight.pop(spec["task_id"], None)
+                sub.buffer.appendleft(spec)
+                sub.state = "RESTARTING?"
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+                return
             self.task_events.record(spec, "SUBMITTED")
             reply = await client.call("PushActorTask", {"spec": spec}, timeout=None)
         except (ConnectionLost, OSError):
-            # actor worker died; buffer for restart or fail on DEAD
-            sub.buffer.appendleft(spec)
+            # Actor worker died with this task dispatched. The task may have
+            # already executed (e.g. it IS the task that killed the actor),
+            # so replaying it after restart would double-execute — fail it
+            # instead, matching the reference's actor_task_submitter
+            # semantics (max_task_retries defaults to 0).
             sub.state = "RESTARTING?"
+            self._fail_task(
+                spec,
+                ActorDiedError(
+                    sub.actor_id, "actor died while this task was in flight"
+                ),
+            )
             asyncio.ensure_future(self._refresh_actor_state(sub))
             return
         finally:

@@ -265,6 +265,16 @@ def flash_causal_attention(q, k, v, *, block_q=None, block_k=None, interpret=Fal
     b, h, t, d = q.shape
     block_q = block_q or _pick_block(t)
     block_k = block_k or _pick_block(t)
+    # The kernel's causal lower bound num_k_blocks = (qi+1)*block_q//block_k
+    # is 0 for early q blocks when block_q < block_k, leaving l==0 and o=NaN.
+    if block_q < block_k or block_q % block_k:
+        raise ValueError(
+            f"block_q ({block_q}) must be a multiple of block_k ({block_k}) "
+            "for the causal flash kernel: its causal bound "
+            "(qi+1)*block_q//block_k floors, skipping keys otherwise"
+        )
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} must be divisible by block sizes")
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)
     vf = v.reshape(b * h, t, d)

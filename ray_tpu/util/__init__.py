@@ -1,31 +1,20 @@
 """ray_tpu.util — placement groups, scheduling strategies, collectives,
 actor pool, queue, state API."""
 
+import importlib
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from ray_tpu.util import collective  # noqa: F401
 
+_LAZY_SUBMODULES = ("collective", "placement_group", "queue", "state")
+
 
 def __getattr__(name):
-    if name == "collective":
-        from ray_tpu.util import collective
-
-        return collective
-    if name == "placement_group":
-        from ray_tpu.util import placement_group
-
-        return placement_group
+    if name in _LAZY_SUBMODULES:
+        return importlib.import_module(f"ray_tpu.util.{name}")
     if name == "ActorPool":
         from ray_tpu.util.actor_pool import ActorPool
 
         return ActorPool
-    if name == "queue":
-        from ray_tpu.util import queue
-
-        return queue
-    if name == "state":
-        from ray_tpu.util import state
-
-        return state
     raise AttributeError(f"module 'ray_tpu.util' has no attribute '{name}'")

@@ -90,6 +90,12 @@ class _Group:
         self._incoming: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # Serializes connection establishment so concurrent _conn(peer) calls
+        # (e.g. world_size==2, where the send and recv neighbor are the same
+        # peer) cannot both miss the cache and dial twice. Safe to hold while
+        # waiting: a dial never blocks on the remote peer's establish lock,
+        # only on its listener (created before KV registration).
+        self._estab_lock = threading.Lock()
         if self.world_size > 1:
             self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -115,29 +121,36 @@ class _Group:
 
     def _conn(self, peer: int) -> socket.socket:
         """One socket per pair: the lower rank dials, the higher accepts."""
+        # Fast path outside _estab_lock: a cached-peer send must not stall
+        # behind another thread's in-progress (up to 60 s) establishment.
         with self._lock:
             if peer in self._conns:
                 return self._conns[peer]
-        if self.rank < peer:
-            addr = _wait_for_addr(self.name, peer)
-            s = socket.create_connection(addr, timeout=_CONNECT_TIMEOUT)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.settimeout(None)
-            s.sendall(struct.pack("<I", self.rank))
-        else:
-            deadline = time.time() + _CONNECT_TIMEOUT
-            with self._cv:
-                while peer not in self._incoming:
-                    left = deadline - time.time()
-                    if left <= 0:
-                        raise TimeoutError(
-                            f"rank {self.rank}: no connection from rank {peer}"
-                        )
-                    self._cv.wait(left)
-                s = self._incoming[peer]
-        with self._lock:
-            self._conns[peer] = s
-        return s
+        with self._estab_lock:
+            with self._lock:
+                if peer in self._conns:
+                    return self._conns[peer]
+            if self.rank < peer:
+                addr = _wait_for_addr(self.name, peer)
+                s = socket.create_connection(addr, timeout=_CONNECT_TIMEOUT)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                s.sendall(struct.pack("<I", self.rank))
+            else:
+                deadline = time.time() + _CONNECT_TIMEOUT
+                with self._cv:
+                    while peer not in self._incoming:
+                        left = deadline - time.time()
+                        if left <= 0:
+                            raise TimeoutError(
+                                f"rank {self.rank}: no connection from rank "
+                                f"{peer}"
+                            )
+                        self._cv.wait(left)
+                    s = self._incoming[peer]
+            with self._lock:
+                self._conns[peer] = s
+            return s
 
     def send_bytes(self, peer: int, payload: bytes):
         _send_msg(self._conn(peer), payload)

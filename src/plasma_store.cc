@@ -28,6 +28,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <unordered_map>
+#include <unordered_set>
 
 namespace {
 
@@ -51,6 +53,9 @@ struct Entry {
 };
 
 // Free/used block header living immediately before each data region.
+// Padded to kAlign (64) so that data offsets — which sit sizeof(Block) past
+// an aligned boundary — are themselves 64-byte aligned end-to-end (zero-copy
+// numpy views and future DMA mappings rely on this).
 struct Block {
   uint64_t size;       // total block size including header
   uint64_t prev_size;  // size of physically-previous block (0 if first)
@@ -58,7 +63,9 @@ struct Block {
   uint32_t _pad;
   uint64_t next_free;  // offset of next free block (0 = none); valid if free
   uint64_t prev_free;  // offset of prev free block
+  uint64_t _pad2[3];   // pad header to 64 bytes
 };
+static_assert(sizeof(Block) == kAlign, "Block header must equal kAlign");
 
 struct Header {
   uint64_t magic;
@@ -70,6 +77,8 @@ struct Header {
   uint64_t free_head;   // offset of first free block (0 = none)
   uint64_t evicted_bytes;
   uint64_t evicted_count;
+  uint64_t poisoned;        // structural corruption detected; all ops fail
+  uint64_t recovered_count; // successful free-list rebuilds after owner death
   pthread_mutex_t mutex;
   Entry table[kTableSize];
 };
@@ -99,13 +108,130 @@ inline uint64_t hash_id(const uint8_t* id) {
   return h;
 }
 
-void lock(Store* s) {
+// A process died while holding the mutex, possibly mid-way through a
+// multi-step mutation (arena_alloc split, arena_free splice, create/delete
+// entry update). The block headers (size/free flags) are single-word writes
+// updated before any list pointers, so the physical chain of blocks is still
+// walkable — rebuild the free list, reconcile the entry table against it,
+// and recompute the counters. Returns 0 on success, -1 if the chain itself
+// is corrupt (then the store must be poisoned, not silently reused).
+int rebuild_after_owner_death(Store* s) {
+  Header* h = s->hdr;
+  const uint64_t kMaxBlocks = kTableSize * 4ULL;
+
+  // Pass 1: validate that blocks tile the arena exactly. ps_open aligns
+  // capacity to kAlign and every allocation is align_up'd, so all sizes must
+  // be kAlign multiples — a stale-payload "header" mid-split rarely is.
+  uint64_t off = sizeof(Block);
+  uint64_t prev_size = 0;
+  uint64_t walked = 0;
+  while (off - sizeof(Block) < h->capacity) {
+    Block* b = block_at(s, off);
+    if (b->size < sizeof(Block) || b->size % kAlign != 0 || b->free > 1 ||
+        off - sizeof(Block) + b->size > h->capacity)
+      return -1;
+    b->prev_size = prev_size;  // repairable from the walk; fix unconditionally
+    prev_size = b->size;
+    off += b->size;
+    if (++walked > kMaxBlocks) return -1;
+  }
+  if (off - sizeof(Block) != h->capacity) return -1;
+
+  // Pass 2: reconcile the entry table. An entry is live only if it points at
+  // the start of a used block big enough to hold it (a crash between
+  // arena_free and the tombstone write in ps_delete/ps_abort, or mid-create,
+  // leaves entries referencing free space — ps_get must never see those).
+  // Process-local index of used blocks keeps this O(entries + blocks).
+  std::unordered_map<uint64_t, uint64_t> used_blocks;  // data off -> block size
+  for (uint64_t boff = sizeof(Block); boff - sizeof(Block) < h->capacity;) {
+    Block* b = block_at(s, boff);
+    if (!b->free) used_blocks.emplace(boff, b->size);
+    boff += b->size;
+  }
+  uint64_t num_objects = 0;
+  std::unordered_set<uint64_t> referenced;
+  for (uint32_t i = 0; i < kTableSize; i++) {
+    Entry* e = &h->table[i];
+    if (e->state != kStateCreated && e->state != kStateSealed) continue;
+    auto it = used_blocks.find(e->offset);
+    if (it != used_blocks.end() &&
+        it->second - sizeof(Block) >= e->data_size) {
+      num_objects++;
+      referenced.insert(e->offset);
+    } else {
+      e->state = kStateTombstone;
+    }
+  }
+
+  // Pass 3: reclaim orphaned used blocks (allocated, but no entry references
+  // them — a crash between arena_alloc and the entry write in ps_create, or
+  // a half-finished split's tail).
+  for (const auto& kv : used_blocks) {
+    if (referenced.find(kv.first) == referenced.end())
+      block_at(s, kv.first)->free = 1;
+  }
+
+  // Pass 4: rebuild the free list (coalescing adjacent frees) + counters.
+  h->free_head = 0;
+  uint64_t used = 0;
+  uint64_t tail_free = 0;  // trailing free run start, for coalescing
+  for (uint64_t boff = sizeof(Block); boff - sizeof(Block) < h->capacity;) {
+    Block* b = block_at(s, boff);
+    uint64_t bsize = b->size;
+    if (b->free) {
+      if (tail_free) {
+        Block* tf = block_at(s, tail_free);
+        tf->size += bsize;
+        Block* after = block_at(s, boff + bsize);
+        if (boff + bsize - sizeof(Block) < h->capacity)
+          after->prev_size = tf->size;
+      } else {
+        tail_free = boff;
+      }
+    } else {
+      if (tail_free) {
+        Block* tf = block_at(s, tail_free);
+        tf->next_free = h->free_head;
+        tf->prev_free = 0;
+        if (h->free_head) block_at(s, h->free_head)->prev_free = tail_free;
+        h->free_head = tail_free;
+        tail_free = 0;
+      }
+      b->next_free = b->prev_free = 0;
+      used += bsize;
+    }
+    boff += bsize;
+  }
+  if (tail_free) {
+    Block* tf = block_at(s, tail_free);
+    tf->next_free = h->free_head;
+    tf->prev_free = 0;
+    if (h->free_head) block_at(s, h->free_head)->prev_free = tail_free;
+    h->free_head = tail_free;
+  }
+  h->used = used;
+  h->num_objects = num_objects;
+  h->recovered_count++;
+  return 0;
+}
+
+// Returns 0 when the lock is held and the store is usable; nonzero otherwise.
+int lock(Store* s) {
   int rc = pthread_mutex_lock(&s->hdr->mutex);
   if (rc == EOWNERDEAD) {
-    // A crashed process held the lock; state is still structurally valid
-    // because all mutations are idempotent-ordered. Mark consistent and go on.
+    // A crashed process held the lock: the shared structures may be
+    // half-mutated. Recover what is provably recoverable; otherwise poison
+    // the store so every client fails loudly instead of corrupting data.
     pthread_mutex_consistent(&s->hdr->mutex);
+    if (rebuild_after_owner_death(s) != 0) s->hdr->poisoned = 1;
+  } else if (rc != 0) {
+    return rc;
   }
+  if (s->hdr->poisoned) {
+    pthread_mutex_unlock(&s->hdr->mutex);
+    return -1;
+  }
+  return 0;
 }
 
 void unlock(Store* s) { pthread_mutex_unlock(&s->hdr->mutex); }
@@ -172,12 +298,19 @@ uint64_t arena_alloc(Store* s, uint64_t size) {
       freelist_remove(s, b, off);
       uint64_t leftover = b->size - need;
       if (leftover >= sizeof(Block) + kAlign) {
-        // split: carve the tail into a new free block
-        b->size = need;
+        // split: carve the tail into a new free block. Write the tail header
+        // fully BEFORE shrinking b->size: owner-death recovery walks blocks
+        // by size, so at every intermediate crash point the chain must tile
+        // the arena (old b->size hides the half-written tail; new b->size
+        // exposes an already-valid tail header).
         uint64_t tail_off = off + need;  // data offsets advance with block size
         Block* tail = block_at(s, tail_off);
         tail->size = leftover;
         tail->prev_size = need;
+        tail->free = 0;  // orphan-used until pushed; recovery reclaims it
+        tail->next_free = tail->prev_free = 0;
+        std::atomic_thread_fence(std::memory_order_release);
+        b->size = need;
         uint64_t after_off = tail_off + leftover;
         Block* ab = block_at(s, after_off);
         if (reinterpret_cast<uint8_t*>(ab) < s->base + h->arena_off + h->capacity)
@@ -263,66 +396,108 @@ enum {
   PS_ERROR = 6,
 };
 
+// Contract: at most one process per node creates a given store name (the
+// raylet); other processes attach with create=0. The stillborn-unlink below
+// is only safe under that contract — it reclaims a name whose creator died
+// mid-init, and would misfire only if a *live* creator stalled >10 s between
+// ftruncate and publishing the magic word.
 void* ps_open(const char* name, uint64_t capacity, int create) {
-  uint64_t map_size = sizeof(Header) + capacity + kAlign;
-  int flags = create ? (O_RDWR | O_CREAT) : O_RDWR;
-  int fd = shm_open(name, flags, 0600);
-  if (fd < 0) return nullptr;
-  bool init = false;
-  if (create) {
-    struct stat st;
-    fstat(fd, &st);
-    if (st.st_size == 0) {
-      if (ftruncate(fd, map_size) != 0) {
-        close(fd);
+  // Two attempts: if attempt 1 finds a stillborn segment (a creator died
+  // between shm_open and publishing the magic word), unlink it and retry the
+  // exclusive create so the name is not wedged forever.
+  for (int attempt = 0; attempt < 2; attempt++) {
+    uint64_t map_size = sizeof(Header) + capacity + kAlign;
+    bool init = false;
+    int fd = -1;
+    if (create) {
+      // O_EXCL picks exactly one initializer: concurrent creators that lose
+      // the race fall through to the attach path and wait for the magic word,
+      // so the header/mutex/free-list are written by a single process.
+      fd = shm_open(name, O_RDWR | O_CREAT | O_EXCL, 0600);
+      if (fd >= 0) {
+        if (ftruncate(fd, map_size) != 0) {
+          close(fd);
+          shm_unlink(name);
+          return nullptr;
+        }
+        init = true;
+      } else if (errno != EEXIST) {
         return nullptr;
       }
-      init = true;
-    } else {
+    }
+    if (fd < 0) {
+      fd = shm_open(name, O_RDWR, 0600);
+      if (fd < 0) {
+        if (create && errno == ENOENT) continue;  // creator unlinked; retry
+        return nullptr;
+      }
+      // The winning creator may not have ftruncate'd yet; wait for the size.
+      struct stat st;
+      st.st_size = 0;
+      for (int i = 0; i < 10000; i++) {
+        if (fstat(fd, &st) != 0) {
+          close(fd);
+          return nullptr;
+        }
+        if (st.st_size > 0) break;
+        usleep(1000);
+      }
+      if (st.st_size == 0) {
+        close(fd);
+        if (create) {
+          shm_unlink(name);  // stillborn: creator died pre-ftruncate
+          continue;
+        }
+        return nullptr;
+      }
       map_size = st.st_size;
     }
-  } else {
-    struct stat st;
-    fstat(fd, &st);
-    map_size = st.st_size;
-  }
-  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-  close(fd);
-  if (mem == MAP_FAILED) return nullptr;
-  Store* s = new Store();
-  s->base = static_cast<uint8_t*>(mem);
-  s->hdr = static_cast<Header*>(mem);
-  s->map_size = map_size;
-  if (init) {
-    Header* h = s->hdr;
-    memset(h, 0, sizeof(Header));
-    h->capacity = map_size - sizeof(Header) - kAlign;
-    h->arena_off = align_up(sizeof(Header));
-    pthread_mutexattr_t attr;
-    pthread_mutexattr_init(&attr);
-    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
-    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
-    pthread_mutex_init(&h->mutex, &attr);
-    // one giant free block spanning the arena; data offset starts after one header
-    uint64_t first_off = sizeof(Block);
-    Block* b = block_at(s, first_off);
-    b->size = h->capacity;
-    b->prev_size = 0;
-    b->free = 0;
-    b->next_free = b->prev_free = 0;
-    freelist_push(s, b, first_off);
-    std::atomic_thread_fence(std::memory_order_seq_cst);
-    h->magic = kMagic;
-  } else {
-    // wait for creator to finish init
-    for (int i = 0; i < 10000 && s->hdr->magic != kMagic; i++) usleep(1000);
-    if (s->hdr->magic != kMagic) {
-      munmap(mem, map_size);
-      delete s;
-      return nullptr;
+    void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) return nullptr;
+    Store* s = new Store();
+    s->base = static_cast<uint8_t*>(mem);
+    s->hdr = static_cast<Header*>(mem);
+    s->map_size = map_size;
+    if (init) {
+      Header* h = s->hdr;
+      memset(h, 0, sizeof(Header));
+      // Align capacity down to kAlign so every block size is a kAlign
+      // multiple — rebuild_after_owner_death relies on this invariant.
+      h->capacity = (map_size - sizeof(Header) - kAlign) & ~(kAlign - 1);
+      h->arena_off = align_up(sizeof(Header));
+      pthread_mutexattr_t attr;
+      pthread_mutexattr_init(&attr);
+      pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+      pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+      pthread_mutex_init(&h->mutex, &attr);
+      // one giant free block spanning the arena; data offset starts after one
+      // header
+      uint64_t first_off = sizeof(Block);
+      Block* b = block_at(s, first_off);
+      b->size = h->capacity;
+      b->prev_size = 0;
+      b->free = 0;
+      b->next_free = b->prev_free = 0;
+      freelist_push(s, b, first_off);
+      std::atomic_thread_fence(std::memory_order_seq_cst);
+      h->magic = kMagic;
+    } else {
+      // wait for creator to finish init
+      for (int i = 0; i < 10000 && s->hdr->magic != kMagic; i++) usleep(1000);
+      if (s->hdr->magic != kMagic) {
+        munmap(mem, map_size);
+        delete s;
+        if (create) {
+          shm_unlink(name);  // stillborn: creator died pre-magic
+          continue;
+        }
+        return nullptr;
+      }
     }
+    return s;
   }
-  return s;
+  return nullptr;
 }
 
 void ps_close(void* handle) {
@@ -351,7 +526,7 @@ uint64_t ps_arena_offset(void* handle) {
 // from ps_base(). Evicts LRU unpinned objects on pressure.
 int ps_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* out_offset) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* existing = find_entry(s, id);
   if (existing) {
     unlock(s);
@@ -387,7 +562,7 @@ int ps_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* out_offs
 
 int ps_seal(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
@@ -402,7 +577,7 @@ int ps_seal(void* handle, const uint8_t* id) {
 // Get pins the object. *out_offset/*out_size valid when PS_OK.
 int ps_get(void* handle, const uint8_t* id, uint64_t* out_offset, uint64_t* out_size) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
@@ -422,7 +597,7 @@ int ps_get(void* handle, const uint8_t* id, uint64_t* out_offset, uint64_t* out_
 
 int ps_contains(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return 0;
   Entry* e = find_entry(s, id);
   int ok = (e && e->state == kStateSealed && !e->pending_delete) ? 1 : 0;
   unlock(s);
@@ -431,7 +606,7 @@ int ps_contains(void* handle, const uint8_t* id) {
 
 int ps_release(void* handle, const uint8_t* id) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
@@ -452,7 +627,7 @@ int ps_delete(void* handle, const uint8_t* id) {
   // zero-copy views held by live Python values stay valid (same contract as
   // the reference plasma client's buffer refcounting).
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
@@ -473,7 +648,7 @@ int ps_delete(void* handle, const uint8_t* id) {
 int ps_abort(void* handle, const uint8_t* id) {
   // Abort an unsealed create (e.g. writer failed mid-copy).
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
@@ -488,7 +663,7 @@ int ps_abort(void* handle, const uint8_t* id) {
 
 int ps_evict(void* handle, uint64_t bytes, uint64_t* out_freed) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return PS_ERROR;
   *out_freed = evict_lru(s, bytes);
   unlock(s);
   return PS_OK;
@@ -497,7 +672,8 @@ int ps_evict(void* handle, uint64_t bytes, uint64_t* out_freed) {
 void ps_stats(void* handle, uint64_t* used, uint64_t* capacity, uint64_t* num_objects,
               uint64_t* evicted_bytes, uint64_t* evicted_count) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  *used = *capacity = *num_objects = *evicted_bytes = *evicted_count = 0;
+  if (lock(s) != 0) return;
   *used = s->hdr->used;
   *capacity = s->hdr->capacity;
   *num_objects = s->hdr->num_objects;
@@ -506,10 +682,24 @@ void ps_stats(void* handle, uint64_t* used, uint64_t* capacity, uint64_t* num_ob
   unlock(s);
 }
 
+// Test-only: acquire the store mutex and return WITHOUT unlocking, so a test
+// process can exit while "holding" it and exercise the EOWNERDEAD recovery.
+int ps_test_lock(void* handle) { return lock(static_cast<Store*>(handle)); }
+
+// Observability: how many owner-death free-list rebuilds have happened, and
+// whether the store has been poisoned by unrecoverable corruption.
+uint64_t ps_recovered_count(void* handle) {
+  return static_cast<Store*>(handle)->hdr->recovered_count;
+}
+
+int ps_poisoned(void* handle) {
+  return static_cast<Store*>(handle)->hdr->poisoned ? 1 : 0;
+}
+
 // List up to max sealed object ids into out (max * kIdSize bytes); returns count.
 uint64_t ps_list(void* handle, uint8_t* out, uint64_t max) {
   Store* s = static_cast<Store*>(handle);
-  lock(s);
+  if (lock(s) != 0) return 0;
   uint64_t n = 0;
   for (uint32_t i = 0; i < kTableSize && n < max; i++) {
     Entry* e = &s->hdr->table[i];

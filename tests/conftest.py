@@ -25,6 +25,63 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------- timeout
+# The reference sets a 180 s default timeout in pytest.ini so one hung test
+# cannot brick CI. pytest-timeout isn't available in this image, so use
+# SIGALRM: it interrupts the main thread even when it is blocked in a
+# syscall (socket recv, poll loop), raising in the test body.
+
+_TEST_TIMEOUT_S = int(os.environ.get("RAY_TPU_TEST_TIMEOUT", "180"))
+
+
+def _install_alarm(phase, item):
+    import faulthandler
+    import signal
+
+    def _abort(signum, frame):
+        faulthandler.dump_traceback()
+        raise TimeoutError(
+            f"{item.nodeid} {phase} exceeded {_TEST_TIMEOUT_S}s timeout"
+        )
+
+    old = signal.signal(signal.SIGALRM, _abort)
+    signal.alarm(_TEST_TIMEOUT_S)
+    return old
+
+
+def _clear_alarm(old):
+    import signal
+
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    old = _install_alarm("setup", item)
+    try:
+        yield
+    finally:
+        _clear_alarm(old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    old = _install_alarm("call", item)
+    try:
+        yield
+    finally:
+        _clear_alarm(old)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    old = _install_alarm("teardown", item)
+    try:
+        yield
+    finally:
+        _clear_alarm(old)
+
 
 @pytest.fixture
 def ray_start_regular():

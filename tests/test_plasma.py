@@ -130,3 +130,52 @@ def test_free_list_coalescing(store):
     buf.release()
     store.seal(big)
     assert store.contains(big)
+
+
+def test_data_offsets_64_byte_aligned(store):
+    # ADVICE r1: zero-copy buffers must be truly 64-byte aligned in the shared
+    # segment (Block header is padded to 64 bytes so data offsets stay aligned).
+    import ctypes
+
+    for size in (1, 63, 64, 1000, 4096 + 17):
+        oid = _oid()
+        buf = store.create(oid, size)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        assert addr % 64 == 0, f"size={size} addr={addr:#x}"
+        buf.release()
+        store.seal(oid)
+
+
+def test_owner_death_recovery(store):
+    """A process that dies while holding the robust mutex must not wedge or
+    corrupt the store: the next locker rebuilds the free list and continues."""
+    import multiprocessing
+
+    # Populate some state first.
+    keep = _oid()
+    store.put_blob(keep, b"survivor" * 100)
+
+    def _die_holding_lock(name):
+        c = PlasmaClient(name)
+        c._test_lock_and_abandon()
+        os._exit(1)
+
+    ctx = multiprocessing.get_context("fork")
+    p = ctx.Process(target=_die_holding_lock, args=(store.name,))
+    p.start()
+    p.join(timeout=30)
+    assert p.exitcode == 1
+
+    # Next operation recovers via EOWNERDEAD instead of deadlocking.
+    oid = _oid()
+    store.put_blob(oid, b"after-recovery" * 10)
+    view = store.get(oid)
+    assert bytes(view) == b"after-recovery" * 10
+    view.release()
+    store.release(oid)
+    view = store.get(keep)
+    assert bytes(view) == b"survivor" * 100
+    view.release()
+    store.release(keep)
+    assert store.recovered_count() >= 1
+    assert not store.poisoned()
