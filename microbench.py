@@ -1,0 +1,211 @@
+"""Control-plane microbenchmarks, ported from the reference's
+`python/ray/_private/ray_perf.py` (task/actor-call/put throughput) and
+`release/microbenchmark` metric names, re-targeted at ray_tpu.
+
+Run:  python microbench.py            # full table, writes MICROBENCH.md
+      python -c 'import microbench; print(microbench.run_quick())'
+
+Numbers compare against BASELINE.md (reference release rig, m5.16xlarge):
+  single_client_tasks_sync 1,046/s · async 8,051/s · 1:1 actor sync 2,050/s ·
+  async 8,719/s · n:n async 28,466/s · put 20.8 GiB/s · pg 814/s.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0):
+    """Run fn repeatedly for >= min_time; return ops/s (fn does `multiplier`
+    ops per call). Mirrors ray_perf.timeit."""
+    for _ in range(warmup):
+        fn()
+    count = 0
+    t0 = time.perf_counter()
+    while True:
+        fn()
+        count += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_time:
+            break
+    rate = count * multiplier / dt
+    print(f"  {name}: {rate:,.1f} /s")
+    return rate
+
+
+def _define_remotes():
+    import ray_tpu
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Actor:
+        def small_value(self):
+            return b"ok"
+
+        def small_value_arg(self, x):
+            return b"ok"
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def small_value(self):
+            return b"ok"
+
+    @ray_tpu.remote
+    class Client:
+        """A driver-side load generator living in its own process
+        (ray_perf's multi-client benches)."""
+
+        def __init__(self, servers):
+            self.servers = servers
+
+        def actor_batch(self, n):
+            import ray_tpu as rt
+
+            rt.get([s.small_value.remote() for s in self.servers
+                    for _ in range(n)])
+
+        def task_batch(self, n):
+            import ray_tpu as rt
+
+            rt.get([small_task.remote() for _ in range(n)])
+
+    return small_task, Actor, AsyncActor, Client
+
+
+def run_benches(quick: bool = False) -> dict:
+    import ray_tpu
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    small_task, Actor, AsyncActor, Client = _define_remotes()
+    results = {}
+    min_time = 0.5 if quick else 2.0
+    batch = 100 if quick else 1000
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        # tasks
+        ray_tpu.get(small_task.remote())  # prime worker + fn export
+        results["single_client_tasks_sync"] = timeit(
+            "single client tasks sync",
+            lambda: ray_tpu.get(small_task.remote()),
+            min_time=min_time)
+        results["single_client_tasks_async"] = timeit(
+            "single client tasks async",
+            lambda: ray_tpu.get([small_task.remote() for _ in range(batch)]),
+            multiplier=batch, min_time=min_time)
+
+        # actor calls
+        a = Actor.remote()
+        ray_tpu.get(a.small_value.remote())
+        results["1_1_actor_calls_sync"] = timeit(
+            "1:1 actor calls sync",
+            lambda: ray_tpu.get(a.small_value.remote()),
+            min_time=min_time)
+        results["1_1_actor_calls_async"] = timeit(
+            "1:1 actor calls async",
+            lambda: ray_tpu.get([a.small_value.remote() for _ in range(batch)]),
+            multiplier=batch, min_time=min_time)
+
+        aa = AsyncActor.remote()
+        ray_tpu.get(aa.small_value.remote())
+        results["1_1_async_actor_calls_async"] = timeit(
+            "1:1 async-actor calls async",
+            lambda: ray_tpu.get([aa.small_value.remote() for _ in range(batch)]),
+            multiplier=batch, min_time=min_time)
+
+        # n:n actor calls — n clients (separate processes) × n servers.
+        # Free the 1:1 actors first: they hold a CPU each and 2n actors must
+        # fit in the cluster.
+        ray_tpu.kill(a)
+        ray_tpu.kill(aa)
+        n = 2 if quick else 4
+        per = 50 if quick else 200
+        servers = [Actor.remote() for _ in range(n)]
+        ray_tpu.get([s.small_value.remote() for s in servers])
+        clients = [Client.remote(servers) for _ in range(n)]
+        ray_tpu.get([c.actor_batch.remote(1) for c in clients])
+        results["n_n_actor_calls_async"] = timeit(
+            "n:n actor calls async",
+            lambda: ray_tpu.get([c.actor_batch.remote(per) for c in clients]),
+            multiplier=n * n * per, min_time=min_time)
+
+        # puts
+        small = b"x" * 100
+        results["single_client_put_calls"] = timeit(
+            "single client put calls (100B)",
+            lambda: ray_tpu.put(small),
+            min_time=min_time)
+        big = np.zeros(256 * 1024 * 1024 // 8, dtype=np.float64)  # 256 MiB
+        gib = big.nbytes / (1 << 30)
+        results["single_client_put_gigabytes"] = timeit(
+            "single client put GiB/s",
+            lambda: ray_tpu.put(big),
+            multiplier=1, min_time=min_time) * gib
+
+        # plasma get calls
+        ref = ray_tpu.put(np.zeros(2 * 1024 * 1024 // 8))  # 2 MiB -> plasma
+        results["single_client_get_calls_plasma"] = timeit(
+            "single client plasma get calls",
+            lambda: ray_tpu.get(ref),
+            min_time=min_time)
+
+        # placement groups — free the n:n actors first so bundles can reserve
+        for actor in servers + clients:
+            ray_tpu.kill(actor)
+
+        def pg_cycle():
+            pg = placement_group([{"CPU": 1}] * 2)
+            pg.ready()  # blocks until reserved (returns self, not a ref)
+            remove_placement_group(pg)
+
+        results["placement_group_create_removal"] = timeit(
+            "pg create+remove", pg_cycle, min_time=min_time)
+    finally:
+        ray_tpu.shutdown()
+    return {k: round(v, 1) for k, v in results.items()}
+
+
+def run_quick() -> dict:
+    """Reduced-duration pass used by bench.py's JSON line."""
+    return run_benches(quick=True)
+
+
+BASELINE = {
+    "single_client_tasks_sync": 1046,
+    "single_client_tasks_async": 8051,
+    "1_1_actor_calls_sync": 2050,
+    "1_1_actor_calls_async": 8719,
+    "n_n_actor_calls_async": 28466,
+    "single_client_put_gigabytes": 20.8,
+    "placement_group_create_removal": 814,
+}
+
+
+def main():
+    results = run_benches(quick=False)
+    lines = [
+        "# Microbenchmarks (ray_perf port)",
+        "",
+        "Run on this machine's CPU control plane via `python microbench.py`.",
+        "Reference numbers from BASELINE.md (release rig, m5.16xlarge).",
+        "",
+        "| metric | ray_tpu | reference | ratio |",
+        "|---|---|---|---|",
+    ]
+    for k, v in results.items():
+        base = BASELINE.get(k)
+        ratio = f"{v / base:.2f}" if base else "—"
+        lines.append(f"| {k} | {v:,} | {base or '—'} | {ratio} |")
+    with open("MICROBENCH.md", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

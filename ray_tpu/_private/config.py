@@ -26,6 +26,11 @@ _FLAGS: Dict[str, Any] = {
     # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
     "scheduler_spread_threshold": 0.5,
     "worker_lease_timeout_ms": 30_000,
+    # Max tasks shipped per PushTasks RPC when the submit queue is deep
+    # (adaptive: batch stays 1 unless queue >> leased workers).
+    "task_push_max_batch": 16,
+    # Cap on concurrent RequestWorkerLease RPCs per scheduling key.
+    "max_lease_requests_in_flight": 16,
     # How long a PG-bound task waits for its group's 2PC to finish before failing.
     "placement_group_ready_timeout_s": 60.0,
     # Max idle workers kept alive per node (soft cap, like num_cpus in reference).

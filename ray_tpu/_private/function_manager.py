@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Dict
 
 import cloudpickle
@@ -25,19 +26,34 @@ class FunctionManager:
         self._kv_get = kv_get
         self._exported: set = set()
         self._cache: Dict[bytes, Any] = {}
+        self._by_obj: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         self._lock = threading.Lock()
 
     def export(self, obj: Any) -> bytes:
-        """Pickle obj, store under its hash, return the key."""
+        """Pickle obj, store under its hash, return the key.
+
+        Memoized per object (weak-keyed, so a driver minting fresh closures
+        per submission doesn't leak memory): re-pickling the same function
+        for every .remote() costs ~0.2 ms/call."""
+        try:
+            memo = self._by_obj.get(obj)
+        except TypeError:
+            memo = None  # unhashable / not weakrefable
+        if memo is not None:
+            return memo
         data = cloudpickle.dumps(obj)
         key = hashlib.sha1(data).digest()
         with self._lock:
-            if key in self._exported:
-                return key
-        self._kv_put(FN_NS, key, data, False)
-        with self._lock:
-            self._exported.add(key)
-            self._cache[key] = obj
+            exported = key in self._exported
+        if not exported:
+            self._kv_put(FN_NS, key, data, False)
+            with self._lock:
+                self._exported.add(key)
+                self._cache[key] = obj
+        try:
+            self._by_obj[obj] = key
+        except TypeError:
+            pass
         return key
 
     def fetch(self, key: bytes) -> Any:

@@ -629,7 +629,11 @@ class GcsServer:
             pg = self.placement_groups.get(pg_id)
             if pg is None or pg["state"] in ("CREATED", "REMOVED"):
                 continue
-            ok = await self._try_create_pg(pg_id, pg)
+            try:
+                ok = await self._try_create_pg(pg_id, pg)
+            except Exception:
+                logger.exception("pg %s creation attempt failed", pg_id.hex())
+                ok = False
             if not ok and self.placement_groups.get(pg_id, {}).get("state") in (
                 "PENDING",
                 "RESCHEDULING",
@@ -640,43 +644,84 @@ class GcsServer:
         placement = self._select_pg_nodes(pg)
         if placement is None:
             return False
-        # Phase 1: prepare (reserve) on each raylet
+        # Phase 1: prepare (reserve) on each raylet, all bundles in parallel
         # (2PC like reference gcs_placement_group_scheduler.h).
-        prepared: List[Tuple[bytes, int]] = []
-        ok = True
-        for bundle, node_id in zip(pg["bundles"], placement):
-            try:
-                raylet = await self._raylet_client(node_id)
-                r = await raylet.call(
-                    "PrepareBundle",
-                    {"pg_id": pg_id, "bundle_index": bundle["index"],
-                     "resources": bundle["resources"]},
-                    timeout=10,
-                )
-                if not r.get("ok"):
-                    ok = False
-                    break
-                prepared.append((node_id, bundle["index"]))
-            except Exception:
-                ok = False
-                break
-        if not ok:
-            for node_id, idx in prepared:
+        async def _prepare(bundle, node_id):
+            raylet = await self._raylet_client(node_id)
+            r = await raylet.call(
+                "PrepareBundle",
+                {"pg_id": pg_id, "bundle_index": bundle["index"],
+                 "resources": bundle["resources"]},
+                timeout=10,
+            )
+            return bool(r.get("ok"))
+
+        results = await asyncio.gather(
+            *(_prepare(b, n) for b, n in zip(pg["bundles"], placement)),
+            return_exceptions=True,
+        )
+        if not all(r is True for r in results):
+            # roll back every successfully-prepared bundle
+            async def _cancel(bundle, node_id):
                 try:
                     raylet = await self._raylet_client(node_id)
-                    await raylet.notify("CancelBundle", {"pg_id": pg_id, "bundle_index": idx})
+                    await raylet.notify(
+                        "CancelBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
                 except Exception:
                     pass
+
+            await asyncio.gather(*(
+                _cancel(b, n)
+                for (b, n), r in zip(zip(pg["bundles"], placement), results)
+                if r is True
+            ))
             return False
-        # Phase 2: commit
-        for bundle, node_id in zip(pg["bundles"], placement):
+
+        # Phase 2: commit, in parallel. A commit failure (raylet died between
+        # prepare and commit) must roll back the committed/prepared bundles
+        # and report failure — NOT raise, or the whole pending queue is lost.
+        async def _commit(bundle, node_id):
             raylet = await self._raylet_client(node_id)
             await raylet.call(
-                "CommitBundle", {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                "CommitBundle",
+                {"pg_id": pg_id, "bundle_index": bundle["index"]},
                 timeout=10,
             )
             bundle["node_id"] = node_id
+
+        commit_results = await asyncio.gather(
+            *(_commit(b, n) for b, n in zip(pg["bundles"], placement)),
+            return_exceptions=True,
+        )
+        if any(isinstance(r, BaseException) for r in commit_results):
+            async def _rollback(bundle, node_id):
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    # ReturnBundle releases committed state; CancelBundle
+                    # covers still-only-prepared bundles. Send both —
+                    # raylets treat unknown bundles as no-ops.
+                    await raylet.notify(
+                        "ReturnBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
+                    await raylet.notify(
+                        "CancelBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
+                except Exception:
+                    pass
+
+            await asyncio.gather(*(
+                _rollback(b, n) for b, n in zip(pg["bundles"], placement)
+            ))
+            for bundle in pg["bundles"]:
+                bundle["node_id"] = None
+            return False
         pg["state"] = "CREATED"
+        if pg.get("ready_event") is not None:
+            pg["ready_event"].set()
         self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
         # PG capacity consumed: retry pending actors that wait on it.
         asyncio.ensure_future(self._schedule_pending_actors())
@@ -698,16 +743,25 @@ class GcsServer:
 
     async def handle_WaitPlacementGroupReady(self, req):
         pg_id = req["pg_id"]
-        timeout = req.get("timeout", 60.0)
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.time() + req.get("timeout", 60.0)
+        while True:
             pg = self.placement_groups.get(pg_id)
-            if pg is None:
+            if pg is None or pg["state"] == "REMOVED":
                 raise ValueError("placement group removed")
             if pg["state"] == "CREATED":
                 return {"ready": True}
-            await asyncio.sleep(0.02)
-        return {"ready": False}
+            # PENDING / RESCHEDULING: wait for the next state transition.
+            # A previous creation may have left the event set (e.g. the PG
+            # went CREATED -> node died -> RESCHEDULING); arm a fresh one.
+            if pg.get("ready_event") is None or pg["ready_event"].is_set():
+                pg["ready_event"] = asyncio.Event()
+            left = deadline - time.time()
+            if left <= 0:
+                return {"ready": False}
+            try:
+                await asyncio.wait_for(pg["ready_event"].wait(), left)
+            except asyncio.TimeoutError:
+                return {"ready": False}
 
     async def handle_RemovePlacementGroup(self, req):
         pg_id = req["pg_id"]
@@ -725,6 +779,8 @@ class GcsServer:
                 except Exception:
                     pass
         pg["state"] = "REMOVED"
+        if pg.get("ready_event") is not None:
+            pg["ready_event"].set()  # wake waiters; they observe REMOVED
         self.pubsub.publish("pg", {"pg_id": pg_id, "state": "REMOVED"})
         return {"ok": True}
 

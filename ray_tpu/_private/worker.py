@@ -388,27 +388,44 @@ class CoreWorker:
         if size <= self.inline_threshold:
             self.io.run(self._store_inline(oid, payload))
         else:
-            blob = self._payload_to_blob(payload)
-            self._plasma_put_local(oid, blob)
-            self.io.run(self._register_plasma_primary(oid, len(blob)))
+            nbytes = self._plasma_put_payload(oid, payload)
+            self.io.run(self._register_plasma_primary(oid, nbytes))
         return ObjectRef(oid, self.address)
 
     async def _store_inline(self, oid: ObjectID, payload):
         self.memory_store.put(oid, (_INLINE, payload, None))
 
-    @staticmethod
-    def _payload_to_blob(payload) -> bytes:
-        out = bytearray(serialization.blob_size(payload["p"], payload["b"]))
-        n = serialization.write_blob(memoryview(out), payload["p"], payload["b"])
-        return bytes(out[:n])
-
-    def _plasma_put_local(self, oid: ObjectID, blob: bytes):
+    def _plasma_put_payload(self, oid: ObjectID, payload) -> int:
+        """Serialize straight into the shared-memory buffer: one copy total
+        (reference plasma clients do the same via Create+mutable buffer,
+        plasma/client.cc). Returns the object's byte size."""
+        size = serialization.blob_size(payload["p"], payload["b"])
         try:
-            self.plasma.put_blob(oid, blob)
+            dest = self.plasma.create(oid, size)
+        except FileExistsError:
+            if self.plasma.contains(oid):
+                return size  # already sealed by an earlier attempt
+            # Unsealed leftover from a crashed/failed writer: readers would
+            # block on it forever. Reclaim and rewrite.
+            self.plasma.abort(oid)
+            dest = self.plasma.create(oid, size)
         except Exception:
             # OOM: evict and retry once
-            self.plasma.evict(len(blob))
-            self.plasma.put_blob(oid, blob)
+            self.plasma.evict(size)
+            dest = self.plasma.create(oid, size)
+        try:
+            serialization.write_blob(dest, payload["p"], payload["b"])
+            dest.release()
+            self.plasma.seal(oid)
+        except BaseException:
+            # Never leave a created-but-unsealed object behind.
+            try:
+                dest.release()
+            except Exception:
+                pass
+            self.plasma.abort(oid)
+            raise
+        return size
 
     async def _register_plasma_primary(self, oid: ObjectID, size: int):
         node = self.node_id.binary()
@@ -695,7 +712,12 @@ class CoreWorker:
         out = []
         for oid in return_ids:
             self.refs.add_owned(oid, lineage_task_id=spec["task_id"])
-        self.io.run(self._mark_pending(return_ids))
+        # Direct call, not io.run: a cross-thread round-trip here costs ~1 ms
+        # per .remote() and caps submission at <1k tasks/s. put_pending only
+        # creates dict entries + an (unbound) asyncio.Event — safe under the
+        # GIL; the result cannot arrive before the spec is posted below.
+        for oid in return_ids:
+            self.memory_store.put_pending(oid)
         for oid in return_ids:
             out.append(ObjectRef(oid, self.address))
         for ref in arg_refs:
@@ -710,10 +732,6 @@ class CoreWorker:
         self.task_events.record(spec, "PENDING")
         return out
 
-    async def _mark_pending(self, return_ids):
-        for oid in return_ids:
-            self.memory_store.put_pending(oid)
-
     async def _submit_normal(self, spec: dict):
         key = ts.scheduling_key(spec)
         state = self._leases.setdefault(key, _LeaseState())
@@ -725,8 +743,13 @@ class CoreWorker:
             lease = state.idle.popleft()
             spec = state.queue.popleft()
             asyncio.ensure_future(self._push_on_lease(key, state, lease, spec))
-        need = len(state.queue) - state.requests_in_flight
-        for _ in range(min(need, 64)):
+        # Bound in-flight lease requests: beyond a handful they only pile up
+        # in the raylet's waiter queue while costing an RPC each.
+        need = min(
+            len(state.queue) - state.requests_in_flight,
+            RTPU_CONFIG.max_lease_requests_in_flight - state.requests_in_flight,
+        )
+        for _ in range(need):
             state.requests_in_flight += 1
             asyncio.ensure_future(self._request_lease(key, state))
 
@@ -848,17 +871,48 @@ class CoreWorker:
         return await self.pool.get(info["ip"], info["raylet_port"])
 
     async def _push_on_lease(self, key, state: _LeaseState, lease, spec: dict):
+        # Adaptive batching: when the queue is deep relative to the number of
+        # leased workers, ship several tasks per RPC — the Python control
+        # plane is message-count-bound (~0.25 ms/message), so tiny-task
+        # throughput scales with batch size. A shallow queue keeps batch=1 so
+        # sparse/long tasks keep per-task latency and full parallelism.
+        batch = [spec]
+        # Divide the queue by workers we have OR expect (outstanding lease
+        # requests), so early grants don't hoard the queue and starve the
+        # leases that are about to arrive.
+        expected_workers = max(
+            1, len(state.all_leases) + state.requests_in_flight
+        )
+        extra = min(
+            len(state.queue) // expected_workers,
+            RTPU_CONFIG.task_push_max_batch - 1,
+        )
+        for _ in range(extra):
+            if not state.queue:
+                break
+            batch.append(state.queue.popleft())
         try:
             client = await self.pool.get(*lease["worker_addr"])
-            self._pending_tasks.get(spec["task_id"], {})["lease"] = lease
-            self.task_events.record(spec, "SUBMITTED")
-            reply = await client.call("PushTask", {"spec": spec}, timeout=None)
+            for s in batch:
+                self._pending_tasks.get(s["task_id"], {})["lease"] = lease
+                self.task_events.record(s, "SUBMITTED")
+            if len(batch) == 1:
+                replies = [await client.call(
+                    "PushTask", {"spec": spec}, timeout=None
+                )]
+            else:
+                r = await client.call(
+                    "PushTasks", {"specs": batch}, timeout=None
+                )
+                replies = r["replies"]
         except (ConnectionLost, OSError) as e:
             state.all_leases.discard(lease["lease_id"])
-            await self._handle_worker_crash(spec, e)
+            for s in batch:
+                await self._handle_worker_crash(s, e)
             await self._pump_leases(key, state)
             return
-        await self._process_task_reply(spec, reply)
+        for s, rep in zip(batch, replies):
+            await self._process_task_reply(s, rep)
         # reuse the lease for queued work, else return it
         if state.queue:
             next_spec = state.queue.popleft()
@@ -1220,8 +1274,9 @@ class CoreWorker:
     async def put_return_to_plasma(self, oid: ObjectID, payload, spec) -> dict:
         """Store a large task return into local plasma; owner is the caller."""
         loop = asyncio.get_running_loop()
-        blob = await loop.run_in_executor(None, self._payload_to_blob, payload)
-        await loop.run_in_executor(None, self._plasma_put_local, oid, blob)
+        size = await loop.run_in_executor(
+            None, self._plasma_put_payload, oid, payload
+        )
         try:
             await self.raylet.notify(
                 "PinObject",
@@ -1229,12 +1284,33 @@ class CoreWorker:
             )
         except Exception:
             pass
-        return {"size": len(blob), "node_id": self.node_id.binary()}
+        return {"size": size, "node_id": self.node_id.binary()}
 
     # -------------------------------------------------------------- handlers
 
     async def handle_PushTask(self, req):
         return await self.executor.execute_normal(req["spec"])
+
+    async def handle_PushTasks(self, req):
+        """Batched push: execute CONCURRENTLY (each task on its own thread),
+        reply in batch. Serial execution would deadlock tasks that
+        synchronize with each other (e.g. a barrier pair landing in one
+        batch); with one thread each they behave exactly as if they'd been
+        granted separate leases, which is the semantics batching must
+        preserve."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        specs = req["specs"]
+        pool = ThreadPoolExecutor(
+            max_workers=len(specs), thread_name_prefix="rtpu-batch"
+        )
+        try:
+            replies = await asyncio.gather(
+                *(self.executor._execute(spec, pool) for spec in specs)
+            )
+        finally:
+            pool.shutdown(wait=False)
+        return {"replies": list(replies)}
 
     async def handle_CreateActor(self, req):
         return await self.executor.create_actor(req["spec"], req["actor_id"])

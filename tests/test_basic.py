@@ -167,3 +167,57 @@ def test_cluster_resources(ray_start_regular):
 def test_remote_call_direct_raises(ray_start_regular):
     with pytest.raises(TypeError):
         add(1, 2)
+
+
+def test_deep_queue_batched_tasks(ray_start_regular):
+    """A deep queue of tiny tasks triggers PushTasks batching; results must
+    stay exact and per-ref ordered."""
+    refs = [add.remote(i, 1) for i in range(400)]
+    assert ray_tpu.get(refs) == [i + 1 for i in range(400)]
+
+
+def test_coordinating_tasks_in_deep_queue(shutdown_only):
+    """Tasks that synchronize with each other must not deadlock when deep-
+    queue batching packs them onto shared leases: batched tasks execute
+    concurrently, as if each had its own lease."""
+    import time as _time
+
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    class Signal:
+        def __init__(self):
+            self.sent = False
+
+        def send(self):
+            self.sent = True
+
+        def ready(self):
+            return self.sent
+
+    sig = Signal.remote()
+
+    # One function for every role so all tasks share a scheduling key and
+    # are eligible for the same PushTasks batches.
+    @ray_tpu.remote
+    def step(role, s):
+        import ray_tpu as rt
+
+        if role == "wait":
+            deadline = _time.time() + 60
+            while not rt.get(s.ready.remote()):
+                if _time.time() > deadline:
+                    return False
+                _time.sleep(0.01)
+            return True
+        if role == "send":
+            rt.get(s.send.remote())
+        return True
+
+    refs = [step.remote("noop", sig) for _ in range(40)]
+    refs += [step.remote("wait", sig) for _ in range(3)]
+    refs += [step.remote("noop", sig) for _ in range(40)]
+    refs += [step.remote("send", sig)]
+    refs += [step.remote("noop", sig) for _ in range(40)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert all(out), out
