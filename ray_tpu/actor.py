@@ -41,6 +41,15 @@ class ActorMethod:
         m = ActorMethod(self._handle, self._method_name, num_returns or self._num_returns)
         return m
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node calling this method on the live actor
+        (reference: actor.py ActorMethod.bind for dag/compiled use)."""
+        from ray_tpu.dag.node import ClassMethodNode, _LiveActorNode
+
+        return ClassMethodNode(
+            _LiveActorNode(self._handle), self._method_name, args, kwargs
+        )
+
     def remote(self, *args, **kwargs):
         worker = get_global_worker()
         refs = worker.submit_actor_task(

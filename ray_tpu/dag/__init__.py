@@ -1,1 +1,8 @@
-from ray_tpu.dag.node import ClassNode, DAGNode, FunctionNode, InputNode  # noqa: F401
+from ray_tpu.dag.node import (  # noqa: F401
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401

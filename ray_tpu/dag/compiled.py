@@ -1,0 +1,358 @@
+"""Compiled actor DAGs: static graphs executed through preallocated
+shared-memory channels with persistent per-actor exec loops.
+
+Reference architecture: python/ray/dag/compiled_dag_node.py:391 (CompiledDAG,
+do_exec_tasks :84, execute :1408) + shared_memory_channel.py:147. The
+TPU-native difference: channels are in-place-mutated plasma objects on the
+node segment (one memcpy handoff, no per-step task submission), and values
+that are jax/numpy arrays ride the serializer's zero-copy buffer path, so a
+same-host pipeline stage handoff never round-trips device data through RPC.
+
+Usage::
+
+    with InputNode() as inp:
+        x = a.f.bind(inp)
+        y = b.g.bind(x)
+    dag = y.experimental_compile()
+    for step in range(1000):
+        ref = dag.execute(step)        # no task submission per step
+        out = ref.get()
+    dag.teardown()
+
+Constraints (same as the reference's aDAG v1): every bound method must be an
+actor method (plain tasks cannot host a persistent loop), the graph is
+static, and all participating actors must live on the driver's node (the
+shared-memory plane is node-local; cross-node pipelines shard by stage).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+    _AttrProxy,
+)
+from ray_tpu.experimental.channel import (
+    Channel,
+    ChannelClosed,
+    _PropagatedError,
+)
+
+
+class _FROM_CHANNEL:
+    """Sentinel marking a positional arg fed by a channel read. A class is
+    pickled by reference, so identity survives the __ray_call__ hop."""
+
+
+class CompiledDAGRef:
+    """Result handle for one execute(); reads the output channels."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value = None
+        self._consumed = False
+
+    def get(self, timeout: Optional[float] = 60.0):
+        return self._dag._read_output(self, timeout)
+
+
+def _exec_loop(self, tasks: List[dict]):
+    """Runs inside the actor (shipped via __ray_call__): read inputs, call
+    the bound method, write the output — forever, until teardown closes a
+    channel. This is the reference's do_exec_tasks."""
+    attached: Dict[bytes, Channel] = {}
+
+    def chan(desc, reader_index):
+        key = desc["oid"]
+        if key not in attached:
+            attached[key] = Channel.attach(desc, reader_index)
+        return attached[key]
+
+    try:
+        while True:
+            for t in tasks:
+                # One read per channel per task-tick: a method consuming the
+                # same upstream twice (f.bind(x, x)) must not double-read.
+                # Per-task (not per-tick): each task owns a distinct reader
+                # slot and must perform its own read to ack it.
+                tick_cache: Dict[bytes, Any] = {}
+                args = []
+                error = None
+                for desc, ridx, unpack in t["reads"]:
+                    key = desc["oid"]
+                    if key in tick_cache:
+                        v = tick_cache[key]
+                    else:
+                        try:
+                            v = chan(desc, ridx).read()
+                        except _PropagatedError as e:
+                            v = e
+                        tick_cache[key] = v
+                    if isinstance(v, _PropagatedError):
+                        error = v
+                        args.append(None)
+                    elif unpack is None:
+                        args.append(v)
+                    else:
+                        args.append(v[unpack])
+                out_chan = chan(t["write"], None)
+                if error is not None:
+                    out_chan.write(error.inner, is_error=True)
+                    continue
+                it = iter(args)
+                bound = [next(it) if s is _FROM_CHANNEL else s
+                         for s in t["static_args"]]
+                try:
+                    result = getattr(self, t["method"])(*bound, **t["kwargs"])
+                except Exception as e:
+                    out_chan.write(e, is_error=True)
+                    continue
+                out_chan.write(result)
+    except ChannelClosed:
+        return None
+
+
+def _start_exec_loop(self, tasks: List[dict]):
+    t = threading.Thread(
+        target=_exec_loop, args=(self, tasks), daemon=True,
+        name="rtpu-dag-exec",
+    )
+    t.start()
+    return True
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode,
+                 buffer_size_bytes: int = 4 * 1024 * 1024):
+        self._buffer_size = buffer_size_bytes
+        self._torn_down = False
+        self._seq = 0
+        self._next_read_seq = 1
+        self._in_flight: List[CompiledDAGRef] = []
+        self._lock = threading.Lock()
+        self._compile(output_node)
+
+    # ------------------------------------------------------------- compile
+
+    def _compile(self, output_node: DAGNode):
+        if isinstance(output_node, MultiOutputNode):
+            outputs = list(output_node._nodes)
+        else:
+            outputs = [output_node]
+        for n in outputs:
+            if not isinstance(n, ClassMethodNode):
+                raise ValueError(
+                    "compiled DAGs support actor-method nodes only "
+                    "(reference: compiled_dag_node.py NotImplementedError)"
+                )
+
+        # Topological collection (args before consumers).
+        order: List[ClassMethodNode] = []
+        seen = set()
+        self._input_node: Optional[InputNode] = None
+
+        def visit(n):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            if isinstance(n, InputNode):
+                self._input_node = n
+                return
+            if isinstance(n, _AttrProxy):
+                visit(n._base)
+                return
+            if not isinstance(n, ClassMethodNode):
+                if isinstance(n, DAGNode):
+                    raise ValueError(
+                        f"unsupported node type in compiled DAG: {type(n)}"
+                    )
+                return
+            for a in list(n._bound_args) + list(n._bound_kwargs.values()):
+                if isinstance(a, DAGNode):
+                    visit(a)
+            order.append(n)
+
+        for n in outputs:
+            visit(n)
+        if not order:
+            raise ValueError("empty DAG")
+
+        # Reader bookkeeping: channel per producing node + the input channel.
+        # Consumer lists are UNIQUE per node: a method consuming the same
+        # upstream twice still occupies one reader slot (the exec loop reads
+        # each channel once per tick), and every allocated slot must have a
+        # live reader or the writer's all-acked wait never completes.
+        consumers: Dict[int, List] = {id(n): [] for n in order}
+        input_consumers: List = []
+        for n in order:
+            seen_bases = set()
+            for a in n._bound_args:
+                base = a._base if isinstance(a, _AttrProxy) else a
+                if id(base) in seen_bases:
+                    continue
+                seen_bases.add(id(base))
+                if isinstance(base, InputNode):
+                    input_consumers.append(n)
+                elif isinstance(base, ClassMethodNode):
+                    consumers[id(base)].append(n)
+        out_reader_idx: Dict[int, int] = {}
+        for n in outputs:
+            consumers[id(n)].append("driver")
+
+        # Allocate channels.
+        self._input_channel = (
+            Channel.create(max(1, len(input_consumers)), self._buffer_size)
+            if input_consumers else None
+        )
+        node_channel: Dict[int, Channel] = {}
+        for n in order:
+            node_channel[id(n)] = Channel.create(
+                max(1, len(consumers[id(n)])), self._buffer_size
+            )
+
+        # Build per-actor task descriptors.
+        input_rix: Dict[int, int] = {}
+        for i, c in enumerate(input_consumers):
+            input_rix.setdefault(id(c), i)
+        node_rix: Dict[int, Dict[int, int]] = {}
+        for n in order:
+            node_rix[id(n)] = {}
+            for i, c in enumerate(consumers[id(n)]):
+                if c == "driver":
+                    out_reader_idx[id(n)] = i
+                else:
+                    node_rix[id(n)][id(c)] = i
+
+        by_actor: Dict[Any, List[dict]] = {}
+        self._actors = []
+        for n in order:
+            handle = n._class_node._ensure_actor()
+            reads = []
+            static_args = []
+            kwargs = {}
+            for a in n._bound_args:
+                unpack = None
+                base = a
+                if isinstance(a, _AttrProxy):
+                    unpack = a._key
+                    base = a._base
+                if isinstance(base, InputNode):
+                    reads.append((self._input_channel.descriptor(),
+                                  input_rix[id(n)], unpack))
+                    static_args.append(_FROM_CHANNEL)
+                elif isinstance(base, ClassMethodNode):
+                    reads.append((node_channel[id(base)].descriptor(),
+                                  node_rix[id(base)][id(n)], unpack))
+                    static_args.append(_FROM_CHANNEL)
+                else:
+                    static_args.append(base)
+            for k, v in n._bound_kwargs.items():
+                if isinstance(v, DAGNode):
+                    raise ValueError("DAG deps must be positional args")
+                kwargs[k] = v
+            by_actor.setdefault(handle, []).append({
+                "method": n._method_name,
+                "reads": reads,
+                "static_args": static_args,
+                "kwargs": kwargs,
+                "write": node_channel[id(n)].descriptor(),
+            })
+
+        # Same-node constraint: the shared-memory plane is node-local.
+        import ray_tpu
+
+        my_node = ray_tpu.get_runtime_context().get_node_id()
+        for handle in by_actor:
+            actor_node = ray_tpu.get(
+                handle.__ray_call__.remote(
+                    lambda self: __import__("ray_tpu")
+                    .get_runtime_context().get_node_id()
+                )
+            )
+            if actor_node != my_node:
+                raise ValueError(
+                    "compiled DAG actors must be on the driver's node "
+                    f"(actor on {actor_node}, driver on {my_node}); "
+                    "shard cross-node pipelines by stage"
+                )
+
+        # Launch exec loops.
+        started = [
+            handle.__ray_call__.remote(_start_exec_loop, tasks)
+            for handle, tasks in by_actor.items()
+        ]
+        ray_tpu.get(started)
+        self._actors = list(by_actor)
+        self._output_channels = [
+            (node_channel[id(n)], out_reader_idx[id(n)]) for n in outputs
+        ]
+        self._output_readers = [
+            Channel(ch._oid, ch._view, ridx, ch._n_readers)
+            for ch, ridx in self._output_channels
+        ]
+        self._all_channels = list(node_channel.values()) + (
+            [self._input_channel] if self._input_channel else []
+        )
+        self._multi_output = isinstance(output_node, MultiOutputNode)
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, *args, timeout: Optional[float] = 60.0):
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        with self._lock:
+            self._seq += 1
+            ref = CompiledDAGRef(self, self._seq)
+            self._in_flight.append(ref)
+        if self._input_channel is not None:
+            value = args[0] if len(args) == 1 else args
+            self._input_channel.write(value, timeout=timeout)
+        return ref
+
+    def _read_output(self, ref: CompiledDAGRef, timeout: Optional[float]):
+        with self._lock:
+            if ref._consumed:
+                return ref._value
+            # Channel reads are strictly ordered; service older refs first.
+            for pending in list(self._in_flight):
+                if pending._seq > ref._seq:
+                    break
+                outs = []
+                err = None
+                for rd in self._output_readers:
+                    try:
+                        outs.append(rd.read(timeout=timeout))
+                    except _PropagatedError as e:
+                        err = e.inner
+                        outs.append(None)
+                pending._consumed = True
+                if err is not None:
+                    pending._value = err
+                    pending._error = True
+                else:
+                    pending._value = (
+                        outs if self._multi_output else outs[0]
+                    )
+                    pending._error = False
+                self._in_flight.remove(pending)
+            if getattr(ref, "_error", False):
+                raise ref._value
+            return ref._value
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._all_channels:
+            try:
+                ch.destroy()
+            except Exception:
+                pass
+
+

@@ -33,6 +33,13 @@ class DAGNode:
 
         return CompiledDAG(self, **kwargs)
 
+    # __getitem__ projects an element of this node's (tuple/dict) output;
+    # __iter__=None keeps that from turning nodes into infinite sequences.
+    __iter__ = None
+
+    def __getitem__(self, key):
+        return _AttrProxy(self, key)
+
 
 class InputNode(DAGNode):
     """Placeholder for the value fed at execute() time."""
@@ -115,3 +122,49 @@ class MultiOutputNode(DAGNode):
 
     def execute(self, input_value=None):
         return [n.execute(input_value) for n in self._nodes]
+
+
+class _LiveActorNode:
+    """ClassNode stand-in wrapping an already-created actor handle, so
+    ``handle.method.bind(...)`` composes with ClassMethodNode."""
+
+    def __init__(self, handle):
+        self._handle = handle
+
+    def _ensure_actor(self):
+        return self._handle
+
+
+class _AttrProxy(DAGNode):
+    """x[i] projection of an upstream node's output (``inp[0]``-style).
+
+    One level only: nested projections (x[0][1]) are rejected — the compiled
+    path unwraps exactly one level, and one level covers the tuple-return
+    idiom the reference supports.
+    """
+
+    # Explicitly non-iterable: without this, __getitem__ would make every
+    # node an infinite sequence under tuple-unpack / list() / iteration.
+    __iter__ = None
+
+    def __init__(self, base: DAGNode, key):
+        super().__init__((), {})
+        if isinstance(base, _AttrProxy):
+            raise ValueError(
+                "nested projections (node[i][j]) are not supported; "
+                "project once and index inside the consuming method"
+            )
+        if not isinstance(key, (int, str)):
+            raise TypeError(f"projection key must be int or str, got {key!r}")
+        self._base = base
+        self._key = key
+
+    def execute(self, input_value=None):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        v = self._base.execute(input_value)
+        if isinstance(v, ObjectRef):
+            import ray_tpu
+
+            v = ray_tpu.get(v)
+        return v[self._key]

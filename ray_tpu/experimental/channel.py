@@ -1,0 +1,211 @@
+"""Mutable shared-memory channels for compiled actor DAGs.
+
+TPU-native counterpart of the reference's shared-memory channels
+(reference: python/ray/experimental/channel/shared_memory_channel.py:147,
+src/ray/core_worker/experimental_mutable_object_manager.h:39): a channel is a
+plasma object that is sealed once and then *mutated in place* — every
+process on the node maps the same writable segment, so handoff is one memcpy
+with no RPC, no allocation, and no per-step object creation.
+
+Protocol (single writer, up to MAX_READERS readers, buffer depth 1):
+
+    header: [u64 write_seq][u64 data_len][u32 flags][u32 n_readers]
+            [u64 ack_seq x MAX_READERS]
+    body:   serialized payload (serialization.write_blob format)
+
+- writer: wait until every registered reader's ack_seq == write_seq
+  (previous value consumed), write body + data_len + flags, memory fence,
+  then publish write_seq+1.
+- reader r: wait until write_seq > last seen, read body, set ack_seq[r].
+Because the writer never mutates while a reader is between "observe seq"
+and "ack", readers never see torn data. Blocking is adaptive spin
+(0 -> 100 us -> 1 ms), fine for the ~ms-scale steps pipelines push through
+channels; a teardown flag turns every blocked peer into ChannelClosed.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Any, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+MAX_READERS = 8
+_HEADER = struct.Struct("<QQII" + "Q" * MAX_READERS)
+_FLAG_ERROR = 1
+_FLAG_CLOSED = 2
+
+DEFAULT_BUFFER_SIZE = 4 * 1024 * 1024
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelFull(Exception):
+    pass
+
+
+def _plasma():
+    from ray_tpu._private.worker import get_global_worker
+
+    return get_global_worker().plasma
+
+
+class Channel:
+    """One-writer/N-reader mutable shared-memory slot.
+
+    Create with ``Channel.create(n_readers)`` on the driver; ship the
+    descriptor (``.descriptor()``) to actors which ``Channel.attach`` it with
+    their reader index (or as writer with ``reader_index=None``).
+    """
+
+    def __init__(self, oid: bytes, view, reader_index: Optional[int],
+                 n_readers: int, own_view=None):
+        self._oid = oid
+        self._view = view  # writable memoryview over the plasma payload
+        self._reader_index = reader_index
+        self._n_readers = n_readers
+        # Resume from this reader's own ack slot — NOT the current write seq:
+        # a value published before the reader attached must still be read.
+        if reader_index is not None:
+            self._last_seen = _HEADER.unpack_from(view, 0)[4 + reader_index]
+        else:
+            self._last_seen = 0
+        self._own = own_view
+
+    # ------------------------------------------------------------ lifecycle
+
+    @staticmethod
+    def create(n_readers: int, buffer_size: int = DEFAULT_BUFFER_SIZE):
+        if not (1 <= n_readers <= MAX_READERS):
+            raise ValueError(f"n_readers must be in [1, {MAX_READERS}]")
+        plasma = _plasma()
+        oid = os.urandom(20)
+        total = _HEADER.size + buffer_size
+        buf = plasma.create(oid, total)
+        buf[: _HEADER.size] = _HEADER.pack(0, 0, 0, n_readers,
+                                           *([0] * MAX_READERS))
+        buf.release()
+        plasma.seal(oid)
+        view = plasma.get(oid)  # pins; writable (shared PROT_WRITE mapping)
+        return Channel(oid, view, None, n_readers, own_view=view)
+
+    @staticmethod
+    def attach(descriptor: dict, reader_index: Optional[int]):
+        plasma = _plasma()
+        view = plasma.get(descriptor["oid"])
+        if view is None:
+            raise ChannelClosed(
+                f"channel object {descriptor['oid'].hex()} not found"
+            )
+        return Channel(descriptor["oid"], view, reader_index,
+                       descriptor["n_readers"], own_view=view)
+
+    def descriptor(self) -> dict:
+        return {"oid": self._oid, "n_readers": self._n_readers}
+
+    def close(self):
+        """Mark closed; blocked peers raise ChannelClosed."""
+        flags = struct.unpack_from("<I", self._view, 16)[0]
+        struct.pack_into("<I", self._view, 16, flags | _FLAG_CLOSED)
+
+    def release(self):
+        try:
+            if self._own is not None:
+                self._own.release()
+                _plasma().release(ObjectID(self._oid))
+                self._own = None
+        except Exception:
+            pass
+
+    def destroy(self):
+        self.close()
+        self.release()
+        try:
+            _plasma().delete(ObjectID(self._oid))
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- plumbing
+
+    def _peek_seq(self) -> int:
+        return struct.unpack_from("<Q", self._view, 0)[0]
+
+    def _flags(self) -> int:
+        return struct.unpack_from("<I", self._view, 16)[0]
+
+    def _acks(self):
+        return _HEADER.unpack_from(self._view, 0)[4:4 + self._n_readers]
+
+    @staticmethod
+    def _spin(predicate, timeout: Optional[float], what: str):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0
+        while not predicate():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {what} timed out")
+            if delay:
+                time.sleep(delay)
+            delay = min((delay or 5e-5) * 2, 1e-3)
+
+    # ------------------------------------------------------------------- io
+
+    def write(self, value: Any, timeout: Optional[float] = None,
+              is_error: bool = False):
+        seq = self._peek_seq()
+
+        def consumed():
+            if self._flags() & _FLAG_CLOSED:
+                raise ChannelClosed("channel closed")
+            return all(a >= seq for a in self._acks())
+
+        self._spin(consumed, timeout, "write")
+        payload, _ = serialization.serialize_inline(value)
+        size = serialization.blob_size(payload["p"], payload["b"])
+        cap = len(self._view) - _HEADER.size
+        if size > cap:
+            raise ChannelFull(
+                f"serialized value is {size} bytes; channel buffer is {cap} "
+                "(pass a larger buffer_size_bytes to experimental_compile)"
+            )
+        serialization.write_blob(
+            self._view[_HEADER.size:], payload["p"], payload["b"]
+        )
+        struct.pack_into("<QI", self._view, 8, size,
+                         _FLAG_ERROR if is_error else 0)
+        # publish: plain store is a fence-enough on x86/ARM under the GIL
+        struct.pack_into("<Q", self._view, 0, seq + 1)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        """Blocking read of the next value; deserializes a fresh copy."""
+        r = self._reader_index
+        if r is None:
+            raise RuntimeError("writer end cannot read")
+
+        def available():
+            if self._flags() & _FLAG_CLOSED:
+                raise ChannelClosed("channel closed")
+            return self._peek_seq() > self._last_seen
+
+        self._spin(available, timeout, "read")
+        seq = self._peek_seq()
+        size, flags = struct.unpack_from("<QI", self._view, 8)
+        body = self._view[_HEADER.size:_HEADER.size + size]
+        value, _refs = serialization.read_blob(bytes(body))
+        self._last_seen = seq
+        struct.pack_into("<Q", self._view, 24 + 8 * r, seq)
+        if flags & _FLAG_ERROR:
+            raise _PropagatedError(value)
+        return value
+
+
+class _PropagatedError(Exception):
+    """Wraps an upstream exception flowing through a channel."""
+
+    def __init__(self, inner):
+        super().__init__(repr(inner))
+        self.inner = inner
