@@ -304,9 +304,14 @@ class GcsServer:
             "state": "RUNNING",
             "entrypoint": req.get("entrypoint", ""),
             "metadata": req.get("metadata", {}),
+            "driver_sys_path": req.get("driver_sys_path", []),
         }
         self.pubsub.publish("job", {"job_id": req["job_id"], "state": "RUNNING"})
         return {"ok": True}
+
+    async def handle_GetJob(self, req):
+        job = self.jobs.get(req["job_id"])
+        return {"found": job is not None, "job": job or {}}
 
     async def handle_MarkJobFinished(self, req):
         job = self.jobs.get(req["job_id"])
@@ -352,6 +357,7 @@ class GcsServer:
             "max_restarts": req.get("max_restarts", 0),
             "num_restarts": 0,
             "detached": req.get("detached", False),
+            "owner_worker_id": req["creation_spec"].get("owner_worker_id"),
             "node_id": None,
             "worker_id": None,
             "addr": None,
@@ -498,7 +504,35 @@ class GcsServer:
         )
         if actor_id:
             await self._on_actor_worker_lost(actor_id, req.get("reason", "worker died"))
+        await self._reap_owned_by(req.get("worker_id"))
         return {"ok": True}
+
+    async def _reap_owned_by(self, worker_id):
+        """Ownership fate-sharing (reference: gcs_actor_manager
+        OnWorkerDead → destroy owned non-detached actors; PG manager
+        cleans up groups whose creator died): kill actors created by the
+        dead worker and remove its placement groups."""
+        if not worker_id:
+            return
+        for aid, rec in list(self.actors.items()):
+            if (rec.get("owner_worker_id") == worker_id
+                    and not rec.get("detached")
+                    and rec["state"] != DEAD):
+                rec["max_restarts"] = rec["num_restarts"]  # no restarts
+                try:
+                    await self.handle_KillActor(
+                        {"actor_id": aid, "no_restart": True}
+                    )
+                except Exception:
+                    pass
+                rec["death_cause"] = "owner worker died"
+        for pg_id, pg in list(self.placement_groups.items()):
+            if (pg.get("owner_worker_id") == worker_id
+                    and pg["state"] != "REMOVED"):
+                try:
+                    await self.handle_RemovePlacementGroup({"pg_id": pg_id})
+                except Exception:
+                    pass
 
     async def handle_GetActorInfo(self, req):
         rec = self.actors.get(req["actor_id"])
@@ -556,6 +590,7 @@ class GcsServer:
             ],
             "state": "PENDING",
             "job_id": req.get("job_id"),
+            "owner_worker_id": req.get("owner_worker_id"),
             "ready_event": None,
         }
         self.pending_pg_queue.append(pg_id)

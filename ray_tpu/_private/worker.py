@@ -221,6 +221,22 @@ class CoreWorker:
     async def _connect(self):
         self.server.register_all(self)
         self.port = await self.server.start(0)
+        if self.mode == MODE_WORKER:
+            # Adopt the driver's sys.path BEFORE the raylet can hand us a
+            # task: by-reference-pickled functions live in modules the driver
+            # can import, and fork-server children don't inherit the driver's
+            # path (reference: job_config code-search-path propagation).
+            try:
+                reply = await self.gcs_aio.call(
+                    "GetJob", {"job_id": self.job_id.binary()}
+                )
+                import sys as _sys
+
+                for p in reply.get("job", {}).get("driver_sys_path", []):
+                    if p not in _sys.path:
+                        _sys.path.append(p)
+            except Exception:
+                pass
         self.raylet = RpcClient(*self._raylet_addr)
         await self.raylet.connect()
         reply = await self.raylet.call(

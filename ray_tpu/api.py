@@ -112,12 +112,19 @@ def init(
         )
         worker.namespace = namespace or ""
         set_global_worker(worker)
+        import sys as _sys
+
         worker.gcs.call(
             "AddJob",
             {
                 "job_id": job_id.binary(),
                 "driver_addr": list(worker.address),
                 "entrypoint": " ".join(os.sys.argv if hasattr(os, "sys") else []),
+                # Workers extend their sys.path with the driver's so that
+                # by-reference-pickled functions (modules importable on the
+                # driver) resolve on workers too (reference: job_config
+                # code-search-path propagation).
+                "driver_sys_path": [p for p in _sys.path if p],
             },
         )
         atexit.register(shutdown)

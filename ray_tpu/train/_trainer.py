@@ -87,6 +87,32 @@ class DataParallelTrainer:
     # ------------------------------------------------------------------- fit
 
     def fit(self) -> Result:
+        """Run training. Like the reference (base_trainer.py:819 wraps the
+        trainer into a Tune Trainable), fit() is a 1-trial Tune run; inside a
+        trial actor it runs the training loop directly."""
+        from ray_tpu.train._session import get_session
+
+        if get_session() is not None:
+            return self._fit_direct()
+        from ray_tpu.tune import Tuner
+
+        grid = Tuner(self).fit()
+        r = grid[0]
+        if r.error:
+            raise TrainingFailedError(
+                f"training failed (trial {r.trial_id}):\n{r.error}"
+            )
+        return Result(
+            metrics=dict(r.metrics or {}),
+            # The trial persisted its own copy of the latest checkpoint the
+            # inner workers reported; fall back to any checkpoints a direct
+            # run left in this trainer's experiment dir.
+            checkpoint=r.checkpoint or self._latest_persisted_checkpoint(),
+            path=self.experiment_dir,
+            metrics_history=list(r.metrics_history),
+        )
+
+    def _fit_direct(self, report_callback=None) -> Result:
         os.makedirs(self.experiment_dir, exist_ok=True)
         failure_config = self.run_config.failure_config or FailureConfig()
         ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
@@ -94,7 +120,8 @@ class DataParallelTrainer:
         latest_checkpoint = self._resume_checkpoint
         while True:
             try:
-                return self._fit_once(latest_checkpoint, ckpt_config)
+                return self._fit_once(latest_checkpoint, ckpt_config,
+                                      report_callback)
             except TrainingFailedError:
                 raise
             except Exception as e:
@@ -113,7 +140,8 @@ class DataParallelTrainer:
                 )
 
     def _fit_once(self, checkpoint: Optional[Checkpoint],
-                  ckpt_config: CheckpointConfig) -> Result:
+                  ckpt_config: CheckpointConfig,
+                  report_callback=None) -> Result:
         sc = self.scaling_config
         group = WorkerGroup(
             sc.num_workers,
@@ -139,7 +167,7 @@ class DataParallelTrainer:
                     (self._train_fn, self._train_config, ctx, checkpoint)
                 )
             group.execute("start_run", per_worker_args=per_worker)
-            return self._poll_reports(group, ckpt_config)
+            return self._poll_reports(group, ckpt_config, report_callback)
         finally:
             group.shutdown()
 
@@ -152,7 +180,8 @@ class DataParallelTrainer:
         return out
 
     def _poll_reports(self, group: WorkerGroup,
-                      ckpt_config: CheckpointConfig) -> Result:
+                      ckpt_config: CheckpointConfig,
+                      report_callback=None) -> Result:
         import ray_tpu
 
         metrics_history: List[Dict[str, Any]] = []
@@ -215,6 +244,14 @@ class DataParallelTrainer:
                         shutil.rmtree(drop, ignore_errors=True)
                         if result_checkpoint.path == drop:
                             result_checkpoint = Checkpoint(saved[-1][1])
+                if report_callback is not None:
+                    # forward the round (and any just-persisted checkpoint)
+                    # to the enclosing Tune trial session
+                    report_callback(
+                        lead,
+                        result_checkpoint.path
+                        if (ckpt_path and result_checkpoint) else None,
+                    )
                 for i in active:
                     group.async_call(i, "ack_report")
         return Result(

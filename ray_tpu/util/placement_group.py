@@ -71,6 +71,12 @@ def placement_group(
             "strategy": strategy,
             "name": name,
             "job_id": worker.job_id.binary(),
+            # Fate-sharing (reference: PGs are owned by their creating
+            # worker/job and reclaimed when it dies) unless detached.
+            "owner_worker_id": (
+                None if lifetime == "detached"
+                else worker.worker_id.binary()
+            ),
         },
     )
     return PlacementGroup(pg_id, bundles)

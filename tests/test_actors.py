@@ -172,3 +172,45 @@ def test_actor_infeasible_resources(ray_start_regular):
     ref = h.get.remote()
     ready, pending = ray_tpu.wait([ref], timeout=1)
     assert pending
+
+
+def test_owner_fate_sharing(ray_start_regular):
+    """Actors and placement groups created by a worker die with it
+    (reference: gcs_actor_manager OnWorkerDead destroys owned actors)."""
+    import time as _time
+
+    from ray_tpu.util.placement_group import placement_group_table
+
+    @ray_tpu.remote
+    class Child:
+        def ping(self):
+            return "ok"
+
+    @ray_tpu.remote
+    class Owner:
+        def setup(self):
+            from ray_tpu.util.placement_group import placement_group
+
+            self.child = Child.options(num_cpus=0).remote()
+            ray_tpu.get(self.child.ping.remote())
+            self.pg = placement_group([{"CPU": 1}])
+            self.pg.ready(timeout=30)
+            return self.child, self.pg.id
+
+    owner = Owner.remote()
+    child, pg_id = ray_tpu.get(owner.setup.remote())
+    assert ray_tpu.get(child.ping.remote()) == "ok"
+    ray_tpu.kill(owner)
+    deadline = _time.time() + 30
+    child_dead = pg_gone = False
+    while _time.time() < deadline and not (child_dead and pg_gone):
+        try:
+            ray_tpu.get(child.ping.remote(), timeout=5)
+        except Exception:
+            child_dead = True
+        table = placement_group_table()
+        rec = table.get(pg_id.hex()) if isinstance(table, dict) else None
+        pg_gone = rec is None or rec.get("state") == "REMOVED"
+        _time.sleep(0.2)
+    assert child_dead, "child actor outlived its owner"
+    assert pg_gone, "placement group outlived its owner"

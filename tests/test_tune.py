@@ -1,0 +1,259 @@
+"""Ray-Tune-subset tests (reference: python/ray/tune/tests/)."""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint
+from ray_tpu.tune import (
+    ASHAScheduler,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+
+
+@pytest.fixture(scope="module")
+def tune_cluster():
+    ray_tpu.init(num_cpus=18)
+    yield
+    ray_tpu.shutdown()
+
+
+def _exp_dir():
+    return tempfile.mkdtemp(prefix="rtpu_tune_")
+
+
+def objective(config):
+    """Converges toward config['target']; higher lr converges faster."""
+    score = 0.0
+    for i in range(config.get("iters", 8)):
+        score += config["lr"]
+        tune.report({"score": score})
+
+
+def test_grid_and_random_expansion():
+    from ray_tpu.tune.search_space import generate_variants
+
+    space = {"a": tune.grid_search([1, 2, 3]), "b": tune.uniform(0, 1), "c": 7}
+    variants = generate_variants(space, num_samples=2, seed=0)
+    assert len(variants) == 6
+    assert sorted(v["a"] for v in variants) == [1, 1, 2, 2, 3, 3]
+    assert all(0 <= v["b"] <= 1 and v["c"] == 7 for v in variants)
+
+
+def test_16_concurrent_trials(tune_cluster):
+    from ray_tpu.train._config import RunConfig
+
+    tuner = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search(
+            [round(0.1 * (i + 1), 1) for i in range(16)]
+        )},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="grid16", storage_path=_exp_dir()),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 16
+    assert not grid.errors
+    best = grid.get_best_result("score")
+    assert best.config["lr"] == 1.6
+    assert best.metrics["score"] == pytest.approx(1.6 * 8)
+
+
+def test_asha_early_stopping(tune_cluster):
+    from ray_tpu.train._config import RunConfig
+
+    def slow_objective(config):
+        score = 0.0
+        for _ in range(32):
+            score += config["lr"]
+            tune.report({"score": score})
+
+    scheduler = ASHAScheduler(
+        metric="score", mode="max", max_t=32, grace_period=2,
+        reduction_factor=4,
+    )
+    tuner = Tuner(
+        slow_objective,
+        param_space={"lr": tune.grid_search(
+            [0.01 * (i + 1) for i in range(16)]
+        )},
+        tune_config=TuneConfig(scheduler=scheduler),
+        run_config=RunConfig(name="asha16", storage_path=_exp_dir()),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    iters = [r.metrics.get("training_iteration", 0) for r in grid]
+    # at least one winner ran to completion; whether losers get rung-stopped
+    # depends on arrival order (ASHA is asynchronous), so early-stop
+    # decisions are asserted deterministically in test_asha_rung_decisions
+    assert max(iters) == 32, iters
+    best = grid.get_best_result("score")
+    assert best.config["lr"] == pytest.approx(0.16)
+
+
+def test_asha_rung_decisions():
+    """Deterministic unit test of the rung cutoff logic: trials arriving at
+    a milestone below the top-1/rf quantile are stopped."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    sched = ASHAScheduler(metric="score", mode="max", max_t=100,
+                          grace_period=4, reduction_factor=4)
+
+    class T:
+        def __init__(self, tid):
+            self.id = tid
+
+    # descending scores arriving at the milestone: first passes freely,
+    # later (worse) arrivals fall below the cutoff and stop
+    decisions = [
+        sched.on_trial_result(None, T(f"t{i}"),
+                              {"training_iteration": 4, "score": 100 - i})
+        for i in range(8)
+    ]
+    assert decisions[0] == CONTINUE
+    assert STOP in decisions[1:], decisions
+    assert decisions.count(STOP) >= 4, decisions
+    # a strictly better late arrival is promoted
+    assert sched.on_trial_result(
+        None, T("late"), {"training_iteration": 4, "score": 1000}
+    ) == CONTINUE
+
+
+def test_pbt_perturbation(tune_cluster):
+    from ray_tpu.train._config import RunConfig
+
+    def ckpt_objective(config):
+        start = 0
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                st = json.load(f)
+            start, score = st["i"], st["score"]
+        for i in range(start, 16):
+            score += config["lr"]
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"i": i + 1, "score": score}, f)
+            tune.report({"score": score}, checkpoint=Checkpoint(d))
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0, 2.0]},
+    )
+    tuner = Tuner(
+        ckpt_objective,
+        param_space={"lr": tune.grid_search([0.1, 0.5, 1.0, 2.0])},
+        tune_config=TuneConfig(scheduler=pbt),
+        run_config=RunConfig(name="pbt4", storage_path=_exp_dir()),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert pbt.num_perturbations > 0
+    best = grid.get_best_result("score")
+    # exploiting the lr=2.0 donor means even former losers end near the top
+    assert best.metrics["score"] >= 16 * 2.0 * 0.5
+
+
+def test_stop_criteria_and_state_file(tune_cluster):
+    from ray_tpu.train._config import RunConfig
+    from ray_tpu.tune.controller import TuneController
+
+    storage = _exp_dir()
+    exp = os.path.join(storage, "stopit")
+
+    def forever(config):
+        i = 0
+        while True:
+            i += 1
+            tune.report({"x": i})
+
+    controller = TuneController(
+        forever, [{}, {}], exp, stop={"training_iteration": 3},
+    )
+    trials = controller.run()
+    assert all(t.state == "TERMINATED" for t in trials)
+    assert all(t.iteration == 3 for t in trials)
+    with open(os.path.join(exp, "experiment_state.json")) as f:
+        state = json.load(f)
+    assert len(state["trials"]) == 2
+
+
+def test_tuner_restore_resumes_unfinished(tune_cluster):
+    from ray_tpu.train._config import RunConfig
+
+    storage = _exp_dir()
+
+    def ckpt_objective(config):
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "state.json")) as f:
+                start = json.load(f)["i"]
+        for i in range(start, 6):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "state.json"), "w") as f:
+                json.dump({"i": i + 1}, f)
+            tune.report({"i": i + 1}, checkpoint=Checkpoint(d))
+
+    tuner = Tuner(
+        ckpt_objective,
+        param_space={"z": tune.grid_search([1, 2])},
+        run_config=RunConfig(name="resume_exp", storage_path=storage),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+
+    # simulate an interruption: mark trial_00001 unfinished at iteration 3
+    exp = os.path.join(storage, "resume_exp")
+    path = os.path.join(exp, "experiment_state.json")
+    with open(path) as f:
+        state = json.load(f)
+    state["trials"][1]["state"] = "RUNNING"
+    state["trials"][1]["iteration"] = 3
+    state["trials"][1]["latest_checkpoint"] = os.path.join(
+        exp, "trial_00001", "checkpoint_000002"
+    )
+    with open(path, "w") as f:
+        json.dump(state, f)
+
+    restored = Tuner.restore(exp, ckpt_objective)
+    grid2 = restored.fit()
+    assert not grid2.errors
+    # trial 0 kept its result without re-running (no new reports); trial 1
+    # resumed from the checkpoint at i=3 and only re-ran rounds 4..6
+    assert grid2[0].metrics["i"] == 6
+    assert len(grid2[0].metrics_history) == 0
+    assert grid2[1].metrics["i"] == 6
+    assert len(grid2[1].metrics_history) == 3
+
+
+def test_trainer_fit_is_one_trial_tune_run(tune_cluster):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        for step in range(3):
+            train.report({"step": step, "lr": config["lr"]})
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"lr": 0.5},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="fit_via_tune", storage_path=_exp_dir()),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 2
+    assert result.metrics["lr"] == 0.5
+    assert len(result.metrics_history) == 3
+    # the tune experiment state lives next to the trainer's checkpoints
+    assert os.path.exists(
+        os.path.join(trainer.experiment_dir, "experiment_state.json")
+    )
