@@ -1,0 +1,121 @@
+"""ray_tpu.data — streaming datasets over the distributed object store.
+
+Reference: python/ray/data (the streaming-executor subset per SURVEY.md §2.3:
+read/from_items → map_batches → iter_batches with operator pools and
+backpressure). Blocks are plasma objects; map stages are task/actor pools;
+iteration overlaps ingest with downstream compute.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block  # noqa: F401
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_BLOCK_ROWS = 1024
+
+
+def from_items(items: List[Any], *, override_num_blocks: Optional[int] = None
+               ) -> Dataset:
+    """Create a dataset from a python list (reference: data.from_items)."""
+    from ray_tpu.data._streaming import _rows_to_block
+
+    n = len(items)
+    if n == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, n // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (n + nblocks - 1) // nblocks)
+    refs = []
+    for i in builtins.range(0, n, per):
+        chunk = list(items[i:i + per])
+        refs.append(ray_tpu.put(_rows_to_block(chunk)))
+    return Dataset(refs)
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    if n == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, n // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (n + nblocks - 1) // nblocks)
+    refs = [
+        ray_tpu.put({"id": np.arange(i, min(n, i + per), dtype=np.int64)})
+        for i in builtins.range(0, n, per)
+    ]
+    return Dataset(refs)
+
+
+def from_numpy(arr, column: str = "data",
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    arr = np.asarray(arr)
+    if len(arr) == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, len(arr) // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (len(arr) + nblocks - 1) // nblocks)
+    refs = [
+        ray_tpu.put({column: arr[i:i + per]})
+        for i in builtins.range(0, len(arr), per)
+    ]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_parquet_task(path: str, columns):
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """One block per parquet file, read in parallel by tasks
+    (reference: data.read_parquet / datasource/parquet_datasource)."""
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.parquet"))))
+        else:
+            files.extend(sorted(glob.glob(p)) or [p])
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {paths}")
+    refs = [_read_parquet_task.remote(f, columns) for f in files]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_csv_task(path: str):
+    import pyarrow.csv as pcsv
+
+    table = pcsv.read_csv(path)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_csv(paths) -> Dataset:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.csv"))))
+        else:
+            files.extend(sorted(glob.glob(p)) or [p])
+    refs = [_read_csv_task.remote(f) for f in files]
+    return Dataset(refs)

@@ -1,0 +1,258 @@
+"""Dataset: lazy logical plan over object-store blocks
+(reference: python/ray/data/dataset.py:139 — the streaming subset).
+
+A Dataset is (source block refs, chain of map operators). Transformations
+append operators; consumption (iter_batches/take/count/materialize) runs the
+streaming executor. Blocks live in plasma; workers read them zero-copy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._streaming import (
+    DEFAULT_MAX_IN_FLIGHT,
+    MapOperator,
+    execute_plan,
+    iter_batches_from_stream,
+)
+from ray_tpu.data.block import (
+    Block,
+    block_num_rows,
+    block_schema,
+    concat_blocks,
+    rows_of,
+    slice_block,
+)
+
+logger = logging.getLogger("ray_tpu.data")
+
+
+class Dataset:
+    def __init__(self, source_refs: List[Any],
+                 operators: Optional[List[MapOperator]] = None,
+                 extra_legs: Optional[List["Dataset"]] = None):
+        self._source_refs = list(source_refs)
+        self._operators = list(operators or [])
+        # union() legs: independent (refs, ops) plans appended lazily
+        self._extra_legs: List["Dataset"] = list(extra_legs or [])
+
+    # ---------------------------------------------------------- transforms
+
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(
+            self._source_refs, self._operators + [op],
+            [leg._with_op(op) for leg in self._extra_legs],
+        )
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        concurrency: Optional[int] = None,
+        fn_constructor_args: tuple = (),
+        num_cpus: float = 1.0,
+        max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+    ) -> "Dataset":
+        """Apply fn to whole blocks (reference: Dataset.map_batches). A class
+        fn runs on an actor pool of `concurrency` actors; a plain function
+        runs as tasks. batch_size=None maps entire blocks (recommended — the
+        executor already sizes blocks)."""
+        is_class = isinstance(fn, type)
+        op = MapOperator(
+            fn,
+            is_batch_fn=True,
+            compute_actors=(concurrency or 2) if is_class else 0,
+            fn_constructor_args=fn_constructor_args,
+            num_cpus=num_cpus,
+            max_in_flight=(concurrency or max_in_flight)
+            if not is_class else max_in_flight,
+            name=getattr(fn, "__name__", "MapBatches"),
+        )
+        ds = self
+        if batch_size is not None:
+            from ray_tpu.data._streaming import RechunkOperator
+
+            ds = ds._with_op(RechunkOperator(batch_size))
+        return ds._with_op(op)
+
+    def map(self, fn: Callable, **kw) -> "Dataset":
+        return self._with_op(MapOperator(fn, is_batch_fn=False, name="Map"))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def batch_fn(block):
+            out = []
+            for row in rows_of(block):
+                out.extend(fn(row))
+            from ray_tpu.data._streaming import _rows_to_block
+
+            return _rows_to_block(out)
+
+        return self._with_op(
+            MapOperator(batch_fn, is_batch_fn=True, name="FlatMap")
+        )
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def batch_fn(block):
+            if isinstance(block, dict):
+                keep = [i for i, row in enumerate(rows_of(block)) if fn(row)]
+                return {k: np.asarray(v)[keep] for k, v in block.items()}
+            return [r for r in block if fn(r)]
+
+        return self._with_op(
+            MapOperator(batch_fn, is_batch_fn=True, name="Filter")
+        )
+
+    # --------------------------------------------------------- re-chunking
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materializing re-chunk into num_blocks equal-ish blocks."""
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = [
+            ray_tpu.put(slice_block(whole, i * per, min(n, (i + 1) * per)))
+            for i in range(min(num_blocks, (n + per - 1) // per))
+        ]
+        return Dataset(refs)
+
+    def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
+        return self.repartition(
+            max(1, (self.count() + rows_per_block - 1) // rows_per_block)
+        )
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Materializing full shuffle (block concat + permutation)."""
+        rng = np.random.default_rng(seed)
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        perm = rng.permutation(n)
+        if isinstance(whole, dict):
+            shuffled: Block = {k: np.asarray(v)[perm] for k, v in whole.items()}
+        else:
+            shuffled = [whole[i] for i in perm]
+        nblocks = max(1, len(self._source_refs))
+        per = max(1, (n + nblocks - 1) // nblocks)
+        refs = [
+            ray_tpu.put(slice_block(shuffled, i * per, min(n, (i + 1) * per)))
+            for i in range((n + per - 1) // per)
+        ]
+        return Dataset(refs)
+
+    def split(self, n: int, equal: bool = True) -> List["Dataset"]:
+        """Materializing row-exact split (reference: Dataset.split).
+        equal=True gives identical shard sizes, dropping up to n-1 trailing
+        rows (like the reference); raises if shards would be empty.
+        equal=False balances floor/ceil sizes with no rows dropped."""
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        total = block_num_rows(whole)
+        if equal:
+            per = total // n
+            if per == 0:
+                raise ValueError(
+                    f"cannot split {total} rows into {n} equal non-empty "
+                    "shards"
+                )
+            sizes = [per] * n
+        else:
+            base, rem = divmod(total, n)
+            sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        out, start = [], 0
+        for size in sizes:
+            out.append(
+                Dataset([ray_tpu.put(slice_block(whole, start, start + size))])
+            )
+            start += size
+        return out
+
+    def split_blocks(self, n: int) -> List["Dataset"]:
+        """Lazy block-granular split: shard i keeps source blocks i::n and
+        the SAME pending operator chain, so per-shard streaming (and
+        ingest/compute overlap) is preserved. Row counts are equal only up
+        to block granularity — the Train ingest path uses this (reference:
+        streaming_split keeps sharding lazy the same way)."""
+        shards: List[Dataset] = []
+        for i in range(n):
+            refs = self._source_refs[i::n]
+            shard = Dataset(refs, self._operators)
+            for leg in self._extra_legs:
+                leg_shards = leg.split_blocks(n)
+                shard = shard.union(leg_shards[i])
+            shards.append(shard)
+        return shards
+
+    def union(self, other: "Dataset") -> "Dataset":
+        """Lazy concatenation: both plans stay pending until consumption."""
+        return Dataset(
+            self._source_refs, self._operators,
+            self._extra_legs + [other],
+        )
+
+    # ---------------------------------------------------------- consumption
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        import itertools
+
+        return itertools.chain(
+            execute_plan(self._source_refs, self._operators),
+            *(leg._iter_block_refs() for leg in self._extra_legs),
+        )
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     prefetch_blocks: int = 2) -> Iterator[Block]:
+        """Streaming iteration: upstream map stages keep working while the
+        consumer processes the current batch (ingest/compute overlap)."""
+        return iter_batches_from_stream(
+            self._iter_block_refs(), batch_size, prefetch_blocks
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_batches(batch_size=None):
+            yield from rows_of(block)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        if not self._operators and not self._extra_legs:
+            return sum(
+                block_num_rows(ray_tpu.get(r)) for r in self._source_refs
+            )
+        return sum(
+            block_num_rows(b) for b in self.iter_batches(batch_size=None)
+        )
+
+    def schema(self):
+        for r in self._iter_block_refs():
+            return block_schema(ray_tpu.get(r))
+        return None
+
+    def materialize(self) -> "Dataset":
+        """Run the plan now; the result holds only materialized blocks."""
+        return Dataset(list(self._iter_block_refs()))
+
+    def num_blocks(self) -> int:
+        return len(self._source_refs) + sum(
+            leg.num_blocks() for leg in self._extra_legs
+        )
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._operators) or "source"
+        return (f"Dataset(num_blocks={len(self._source_refs)}, "
+                f"plan={ops})")

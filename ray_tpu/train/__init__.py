@@ -17,6 +17,7 @@ from ray_tpu.train._config import (
 from ray_tpu.train._session import (
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train._trainer import (
@@ -39,5 +40,6 @@ __all__ = [
     "TrainingFailedError",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "report",
 ]

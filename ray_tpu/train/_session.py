@@ -44,9 +44,11 @@ class TrainContext:
 
 
 class _Session:
-    def __init__(self, ctx: TrainContext, latest_checkpoint: Optional[Checkpoint]):
+    def __init__(self, ctx: TrainContext, latest_checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.ctx = ctx
         self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self.reports: "queue.Queue" = queue.Queue()
         self.consumed = threading.Event()
         self.finished = False
@@ -64,10 +66,11 @@ _session: Optional[_Session] = None
 _session_lock = threading.Lock()
 
 
-def init_session(ctx: TrainContext, checkpoint: Optional[Checkpoint]) -> _Session:
+def init_session(ctx: TrainContext, checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[Dict[str, Any]] = None) -> _Session:
     global _session
     with _session_lock:
-        _session = _Session(ctx, checkpoint)
+        _session = _Session(ctx, checkpoint, dataset_shards)
         return _session
 
 
@@ -96,6 +99,23 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
     if s is None:
         raise RuntimeError("ray_tpu.train.report() outside a train worker")
     s.report(metrics, checkpoint)
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of a dataset passed to the trainer
+    (reference: train.get_dataset_shard / DataConfig sharding)."""
+    s = get_session()
+    if s is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_dataset_shard() outside a train worker"
+        )
+    shard = s.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(available: {list(s.dataset_shards)})"
+        )
+    return shard
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -86,8 +86,9 @@ class _TrainWorker:
         config: Optional[dict],
         ctx: TrainContext,
         checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
     ):
-        session = init_session(ctx, checkpoint)
+        session = init_session(ctx, checkpoint, dataset_shards)
 
         import inspect
 
