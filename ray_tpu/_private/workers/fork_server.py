@@ -47,6 +47,20 @@ def _child_main(args, spawn):
     os.setsid()
     for k, v in (spawn.get("env") or {}).items():
         os.environ[k] = str(v)
+    # If jax was preimported (by us or a plugin), its platform config may
+    # have been baked at import time — some platform plugins even force
+    # their own value, ignoring the env. Re-sync from the (inherited +
+    # overridden) environment before any backend initializes, so workers
+    # honor JAX_PLATFORMS/XLA_FLAGS exactly like a fresh process would.
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_platforms", os.environ.get("JAX_PLATFORMS") or None
+            )
+        except Exception:
+            pass
     log_prefix = spawn.get("log_prefix", "")
     if log_prefix:
         out = open(log_prefix + ".out", "ab", buffering=0)

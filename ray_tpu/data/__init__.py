@@ -117,5 +117,7 @@ def read_csv(paths) -> Dataset:
             files.extend(sorted(glob.glob(os.path.join(p, "*.csv"))))
         else:
             files.extend(sorted(glob.glob(p)) or [p])
+    if not files:
+        raise FileNotFoundError(f"no csv files under {paths}")
     refs = [_read_csv_task.remote(f) for f in files]
     return Dataset(refs)

@@ -78,21 +78,24 @@ class MapOperator:
             yield from self._stream_tasks(upstream)
 
     def _stream_tasks(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        import collections
+
         import cloudpickle
 
         payload = cloudpickle.dumps(self.fn)
-        in_flight: List[Any] = []
+        # Yield in INPUT order (completion order would make block order — and
+        # therefore take()/iter_batches contents — nondeterministic): block
+        # on the oldest outstanding task whenever the window is full.
+        in_flight: "collections.deque" = collections.deque()
         task = _map_block_task.options(num_cpus=self.num_cpus)
         for ref in upstream:
             in_flight.append(
                 task.remote(payload, ref, is_batch_fn=self.is_batch_fn)
             )
             while len(in_flight) >= self.max_in_flight:
-                ready, in_flight = ray_tpu.wait(in_flight, num_returns=1)
-                yield from ready
+                yield in_flight.popleft()
         while in_flight:
-            ready, in_flight = ray_tpu.wait(in_flight, num_returns=1)
-            yield from ready
+            yield in_flight.popleft()
 
     def _stream_actors(self, upstream: Iterator[Any]) -> Iterator[Any]:
         """Class-based UDF on a pool of actors (reference: ActorPoolStrategy).
@@ -118,25 +121,25 @@ class MapOperator:
             )
             for _ in range(self.compute_actors)
         ]
+        import collections
+
         per_actor_cap = max(2, self.max_in_flight // len(pool))
-        in_flight: Dict[Any, int] = {}
+        in_flight: "collections.deque" = collections.deque()  # (ref, idx)
         load = [0] * len(pool)
         try:
             for ref in upstream:
                 while sum(load) >= per_actor_cap * len(pool):
-                    ready, _ = ray_tpu.wait(list(in_flight), num_returns=1)
-                    for r in ready:
-                        load[in_flight.pop(r)] -= 1
-                        yield r
+                    done_ref, done_idx = in_flight.popleft()
+                    load[done_idx] -= 1
+                    yield done_ref  # input order preserved
                 idx = min(range(len(pool)), key=lambda i: load[i])
                 out = pool[idx].apply.remote(ref, self.is_batch_fn)
-                in_flight[out] = idx
+                in_flight.append((out, idx))
                 load[idx] += 1
             while in_flight:
-                ready, _ = ray_tpu.wait(list(in_flight), num_returns=1)
-                for r in ready:
-                    load[in_flight.pop(r)] -= 1
-                    yield r
+                done_ref, done_idx = in_flight.popleft()
+                load[done_idx] -= 1
+                yield done_ref
         finally:
             for a in pool:
                 try:
@@ -145,9 +148,27 @@ class MapOperator:
                     pass
 
 
+def rechunk_blocks(blocks: Iterator[Block], rows: int) -> Iterator[Block]:
+    """Re-chunk a stream of blocks to exactly `rows` per block (short tail),
+    with bounded memory: the current accumulation plus one upstream block."""
+    pending: Optional[Block] = None
+    for block in blocks:
+        if pending is not None:
+            block = concat_blocks([pending, block])
+            pending = None
+        n = block_num_rows(block)
+        off = 0
+        while n - off >= rows:
+            yield slice_block(block, off, off + rows)
+            off += rows
+        if off < n:
+            pending = slice_block(block, off, n)
+    if pending is not None and block_num_rows(pending):
+        yield pending
+
+
 class RechunkOperator:
-    """Lazy in-stream re-chunking to a fixed rows-per-block, with bounded
-    memory (current accumulation + one upstream block). Used by
+    """Lazy in-stream re-chunking to a fixed rows-per-block. Used by
     map_batches(batch_size=N) so the plan is never executed twice."""
 
     def __init__(self, rows_per_block: int):
@@ -155,21 +176,9 @@ class RechunkOperator:
         self.name = f"Rechunk({rows_per_block})"
 
     def stream(self, upstream: Iterator[Any]) -> Iterator[Any]:
-        pending: Optional[Block] = None
-        for ref in upstream:
-            block = ray_tpu.get(ref)
-            if pending is not None:
-                block = concat_blocks([pending, block])
-                pending = None
-            n = block_num_rows(block)
-            off = 0
-            while n - off >= self.rows:
-                yield ray_tpu.put(slice_block(block, off, off + self.rows))
-                off += self.rows
-            if off < n:
-                pending = slice_block(block, off, n)
-        if pending is not None and block_num_rows(pending):
-            yield ray_tpu.put(pending)
+        blocks = (ray_tpu.get(r) for r in upstream)
+        for out in rechunk_blocks(blocks, self.rows):
+            yield ray_tpu.put(out)
 
 
 def execute_plan(source_refs: List[Any],
@@ -191,32 +200,18 @@ def iter_batches_from_stream(
 
     window: "collections.deque" = collections.deque()
 
-    def fill():
-        while len(window) < max(1, prefetch_blocks):
-            try:
-                window.append(next(ref_stream))
-            except StopIteration:
-                return False
-        return True
+    def blocks():
+        while True:
+            while len(window) < max(1, prefetch_blocks):
+                try:
+                    window.append(next(ref_stream))
+                except StopIteration:
+                    break
+            if not window:
+                return
+            yield ray_tpu.get(window.popleft())
 
-    leftover: Optional[Block] = None
-    while True:
-        fill()
-        if not window:
-            break
-        block = ray_tpu.get(window.popleft())
-        if batch_size is None:
-            yield block
-            continue
-        if leftover is not None:
-            block = concat_blocks([leftover, block])
-            leftover = None
-        n = block_num_rows(block)
-        off = 0
-        while n - off >= batch_size:
-            yield slice_block(block, off, off + batch_size)
-            off += batch_size
-        if off < n:
-            leftover = slice_block(block, off, n)
-    if leftover is not None and block_num_rows(leftover):
-        yield leftover
+    if batch_size is None:
+        yield from blocks()
+        return
+    yield from rechunk_blocks(blocks(), batch_size)

@@ -81,8 +81,12 @@ class Dataset:
             ds = ds._with_op(RechunkOperator(batch_size))
         return ds._with_op(op)
 
-    def map(self, fn: Callable, **kw) -> "Dataset":
-        return self._with_op(MapOperator(fn, is_batch_fn=False, name="Map"))
+    def map(self, fn: Callable, *, num_cpus: float = 1.0,
+            max_in_flight: int = DEFAULT_MAX_IN_FLIGHT) -> "Dataset":
+        return self._with_op(MapOperator(
+            fn, is_batch_fn=False, num_cpus=num_cpus,
+            max_in_flight=max_in_flight, name="Map",
+        ))
 
     def flat_map(self, fn: Callable) -> "Dataset":
         def batch_fn(block):
@@ -179,13 +183,12 @@ class Dataset:
         ingest/compute overlap) is preserved. Row counts are equal only up
         to block granularity — the Train ingest path uses this (reference:
         streaming_split keeps sharding lazy the same way)."""
+        leg_shards = [leg.split_blocks(n) for leg in self._extra_legs]
         shards: List[Dataset] = []
         for i in range(n):
-            refs = self._source_refs[i::n]
-            shard = Dataset(refs, self._operators)
-            for leg in self._extra_legs:
-                leg_shards = leg.split_blocks(n)
-                shard = shard.union(leg_shards[i])
+            shard = Dataset(self._source_refs[i::n], self._operators)
+            for per_leg in leg_shards:
+                shard = shard.union(per_leg[i])
             shards.append(shard)
         return shards
 
@@ -231,8 +234,11 @@ class Dataset:
 
     def count(self) -> int:
         if not self._operators and not self._extra_legs:
+            if not self._source_refs:
+                return 0
             return sum(
-                block_num_rows(ray_tpu.get(r)) for r in self._source_refs
+                block_num_rows(b)
+                for b in ray_tpu.get(list(self._source_refs))
             )
         return sum(
             block_num_rows(b) for b in self.iter_batches(batch_size=None)
