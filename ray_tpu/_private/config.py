@@ -42,6 +42,16 @@ _FLAGS: Dict[str, Any] = {
     "health_check_period_ms": 1000,
     "health_check_failure_threshold": 5,
     "max_lineage_bytes": 64 * 1024**2,
+    # --- GCS fault tolerance ----------------------------------------------
+    # Persist GCS tables to <session_dir>/gcs.log so a restarted GCS resumes
+    # the cluster (reference: redis_store_client.h).
+    "gcs_persistence": True,
+    # fsync every log append (durability vs throughput).
+    "gcs_log_fsync": False,
+    # Compact the append log into a snapshot once it exceeds this size.
+    "gcs_log_compact_bytes": 64 * 1024**2,
+    # How long clients retry connecting to a dead GCS before giving up.
+    "gcs_reconnect_timeout_s": 30.0,
     # --- timeouts ----------------------------------------------------------
     "gcs_rpc_timeout_s": 30.0,
     "get_timeout_warning_s": 10.0,

@@ -16,7 +16,13 @@ from ray_tpu._private.rpc import ConnectionLost, IoThread, RpcClient
 
 
 class GcsAioClient:
-    """All methods must run on the IO loop."""
+    """All methods must run on the IO loop.
+
+    Calls that hit a dead GCS retry with backoff for up to
+    ``gcs_reconnect_timeout_s`` — this is what lets raylets and workers ride
+    out a GCS restart (reference: gcs_rpc_server_reconnect_timeout_s and the
+    retryable gRPC client, src/ray/rpc/gcs_server/gcs_rpc_client.h).
+    """
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, port
@@ -32,11 +38,28 @@ class GcsAioClient:
                     self._client = c
         return self._client
 
-    async def call(self, method, payload=None, timeout=None):
-        c = await self._c()
-        return await c.call(
-            method, payload, timeout or RTPU_CONFIG.gcs_rpc_timeout_s
-        )
+    async def call(self, method, payload=None, timeout=None, retry_s=None):
+        """Issue an RPC; retry connection failures until ``retry_s`` elapses.
+
+        Only transport failures are retried (the GCS handlers are
+        at-least-once safe: table writes are idempotent overwrites); remote
+        exceptions and response timeouts propagate immediately.
+        """
+        if retry_s is None:
+            retry_s = RTPU_CONFIG.gcs_reconnect_timeout_s
+        deadline = asyncio.get_running_loop().time() + retry_s
+        delay = 0.05
+        while True:
+            try:
+                c = await self._c()
+                return await c.call(
+                    method, payload, timeout or RTPU_CONFIG.gcs_rpc_timeout_s
+                )
+            except (ConnectionLost, ConnectionError, OSError):
+                if asyncio.get_running_loop().time() >= deadline:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
 
     async def notify(self, method, payload=None):
         try:
@@ -87,8 +110,8 @@ class GcsClient:
     def address(self):
         return f"{self.aio.host}:{self.aio.port}"
 
-    def call(self, method, payload=None, timeout=None):
-        return self._io.run(self.aio.call(method, payload, timeout))
+    def call(self, method, payload=None, timeout=None, retry_s=None):
+        return self._io.run(self.aio.call(method, payload, timeout, retry_s))
 
     def kv_put(self, ns, key, value, overwrite=True):
         return self._io.run(self.aio.kv_put(ns, key, value, overwrite))
@@ -112,4 +135,6 @@ class GcsClient:
         return self.call("GetClusterResources", {})
 
     def ping(self, timeout=5):
-        return self.call("Ping", {}, timeout=timeout)
+        # Bounded retry window: a ping probe should fail fast, not wait out
+        # the full reconnect budget.
+        return self.call("Ping", {}, timeout=timeout, retry_s=timeout)

@@ -9,8 +9,12 @@ events, and the cluster resource view that feeds scheduling/spillback and the
 autoscaler. Everything runs on one asyncio loop, like the reference's single
 asio io_context.
 
-State is in-memory with an optional JSON-lines append log for KV/job/actor
-tables (GCS restart tolerance; reference uses Redis for this).
+State is in-memory, persisted through a msgpack append log
+(``persistence.GcsLog``) covering the KV/job/actor/named-actor/placement-
+group/node tables. On restart the log replays and the cluster resumes:
+raylets re-register on their next heartbeat, pubsub subscribers re-subscribe
+when they observe a new server epoch (reference uses Redis for this —
+src/ray/gcs/store_client/redis_store_client.h).
 """
 
 from __future__ import annotations
@@ -21,9 +25,11 @@ import logging
 import os
 import sys
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.gcs.persistence import GcsLog
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.rpc import ClientPool, RpcServer
 
@@ -117,7 +123,7 @@ class PubSub:
 
 
 class GcsServer:
-    def __init__(self, host="127.0.0.1", session_dir: str = ""):
+    def __init__(self, host="127.0.0.1", session_dir: str = "", persist_path: str = ""):
         self.host = host
         self.session_dir = session_dir
         self.server = RpcServer(host)
@@ -125,6 +131,18 @@ class GcsServer:
         self.pubsub = PubSub()
         self.pool = ClientPool()  # clients to raylets / workers
         self.start_time = time.time()
+        # A fresh epoch per server process: clients detect a restart by the
+        # epoch changing and re-subscribe their pubsub channels.
+        self.epoch = uuid.uuid4().hex
+        if not persist_path and session_dir and RTPU_CONFIG.gcs_persistence:
+            persist_path = os.path.join(session_dir, "gcs.log")
+        self.log: Optional[GcsLog] = (
+            GcsLog(persist_path, fsync=RTPU_CONFIG.gcs_log_fsync)
+            if persist_path
+            else None
+        )
+        self._compacting = False
+        self._compact_buffer: List[Tuple[str, Any]] = []
 
         # node_id(bytes) -> info dict
         self.nodes: Dict[bytes, dict] = {}
@@ -150,12 +168,152 @@ class GcsServer:
     def alive_nodes(self) -> List[bytes]:
         return [nid for nid, n in self.nodes.items() if n["state"] == "ALIVE"]
 
+    # ---------------------------------------------------------- persistence
+
+    def _persist(self, kind: str, data):
+        if self.log is None:
+            return
+        if self._compacting:
+            # A snapshot write is in flight off-loop; appends to the old file
+            # would be clobbered by the rename. Buffer and flush after.
+            self._compact_buffer.append((kind, data))
+            return
+        try:
+            self.log.append(kind, data)
+        except Exception:
+            logger.exception("gcs log append failed")
+
+    def _persist_actor(self, rec: dict):
+        self._persist("actor", rec)
+
+    def _persist_pg(self, pg: dict):
+        self._persist("pg", {k: v for k, v in pg.items() if k != "ready_event"})
+
+    def _restore(self):
+        """Replay the append log into the in-memory tables, then compact.
+
+        A malformed record (version skew, partial corruption past the frame
+        check) is skipped, never fatal: a GCS that cannot start is strictly
+        worse than one missing a record, and the node monitor would respawn
+        a crashing GCS forever.
+        """
+        if self.log is None:
+            return
+        n = 0
+        try:
+            replay = list(self.log.replay())
+        except Exception:
+            logger.exception("gcs log unreadable; starting empty")
+            return
+        for kind, data in replay:
+            try:
+                n += 1
+                if kind == "kv":
+                    ns, key, value = data
+                    if value is None:
+                        self.kv.delete(ns, key)
+                    else:
+                        self.kv.put(ns, key, value)
+                elif kind == "job":
+                    self.jobs[data["job_id"]] = data
+                elif kind == "actor":
+                    self.actors[data["actor_id"]] = data
+                elif kind == "named":
+                    ns, name, actor_id = data
+                    if actor_id is None:
+                        self.named_actors.pop((ns, name), None)
+                    else:
+                        self.named_actors[(ns, name)] = actor_id
+                elif kind == "pg":
+                    data["ready_event"] = None
+                    self.placement_groups[data["pg_id"]] = data
+                elif kind == "node":
+                    self.nodes[data["node_id"]] = data
+            except Exception:
+                logger.exception("skipping malformed gcs log record kind=%r", kind)
+        if n == 0:
+            return
+        now = time.time()
+        for node_id, info in self.nodes.items():
+            # Give restored nodes a full grace window to heartbeat back in.
+            self.node_last_beat[node_id] = now
+        for actor_id, rec in self.actors.items():
+            if rec["state"] in (PENDING_CREATION, RESTARTING):
+                self.pending_actor_queue.append(actor_id)
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] in ("PENDING", "RESCHEDULING"):
+                self.pending_pg_queue.append(pg_id)
+        logger.info(
+            "GCS restored from %s: %d records, %d nodes, %d actors, %d pgs, %d jobs",
+            self.log.path, n, len(self.nodes), len(self.actors),
+            len(self.placement_groups), len(self.jobs),
+        )
+        self._compact()
+
+    def _snapshot_records(self) -> List[Tuple[str, Any]]:
+        records: List[Tuple[str, Any]] = []
+        for ns, table in self.kv._data.items():
+            for key, value in table.items():
+                records.append(("kv", [ns, key, value]))
+        for job in self.jobs.values():
+            records.append(("job", job))
+        for rec in self.actors.values():
+            records.append(("actor", rec))
+        for (ns, name), actor_id in self.named_actors.items():
+            records.append(("named", [ns, name, actor_id]))
+        for pg in self.placement_groups.values():
+            records.append(
+                ("pg", {k: v for k, v in pg.items() if k != "ready_event"})
+            )
+        for info in self.nodes.values():
+            records.append(("node", info))
+        return records
+
+    def _compact(self):
+        if self.log is None:
+            return
+        try:
+            self.log.compact(self._snapshot_records())
+        except Exception:
+            logger.exception("gcs log compaction failed")
+
+    async def _compaction_loop(self):
+        """Compact off-loop: the snapshot is captured synchronously (cheap,
+        point-in-time consistent) but the serialize+fsync runs in a thread so
+        a large state dump cannot stall heartbeat handling past the health
+        threshold and wrongly kill every node."""
+        limit = RTPU_CONFIG.gcs_log_compact_bytes
+        while True:
+            await asyncio.sleep(5.0)
+            if self.log is None or self.log.size() <= limit or self._compacting:
+                continue
+            records = self._snapshot_records()
+            self._compacting = True
+            self._compact_buffer = []
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.log.compact, records
+                )
+            except Exception:
+                logger.exception("gcs log compaction failed")
+            finally:
+                self._compacting = False
+                buffered, self._compact_buffer = self._compact_buffer, []
+                for kind, data in buffered:
+                    self._persist(kind, data)
+
     # ------------------------------------------------------------- lifecycle
 
     async def start(self, port: int = 0) -> int:
+        self._restore()
         self.server.register_all(self)
         port = await self.server.start(port)
         self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._compaction_loop()))
+        if self.pending_actor_queue:
+            asyncio.ensure_future(self._schedule_pending_actors())
+        if self.pending_pg_queue:
+            asyncio.ensure_future(self._schedule_pending_pgs())
         logger.info("GCS listening on %s:%s", self.host, port)
         return port
 
@@ -179,6 +337,7 @@ class GcsServer:
         info["state"] = "DEAD"
         info["end_time"] = time.time()
         logger.warning("node %s dead: %s", node_id.hex(), reason)
+        self._persist("node", info)
         self.pubsub.publish("node", {"node_id": node_id, "state": "DEAD"})
         # Fail/restart actors that lived on this node.
         for actor_id, rec in list(self.actors.items()):
@@ -193,6 +352,7 @@ class GcsServer:
                 for b in pg["bundles"]:
                     if b.get("node_id") == node_id:
                         b["node_id"] = None
+                self._persist_pg(pg)
                 self.pending_pg_queue.append(pg_id)
                 asyncio.ensure_future(self._schedule_pending_pgs())
 
@@ -214,6 +374,7 @@ class GcsServer:
             "is_head": bool(req.get("is_head")),
         }
         self.node_last_beat[node_id] = time.time()
+        self._persist("node", self.nodes[node_id])
         self.pubsub.publish("node", {"node_id": node_id, "state": "ALIVE"})
         # New capacity: retry pending actors/PGs.
         asyncio.ensure_future(self._schedule_pending_actors())
@@ -225,7 +386,12 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_Heartbeat(self, req):
-        self.node_last_beat[req["node_id"]] = time.time()
+        node_id = req["node_id"]
+        self.node_last_beat[node_id] = time.time()
+        # "known" lets a raylet detect a GCS that restarted without its
+        # registration (e.g. persistence disabled) and re-register.
+        info = self.nodes.get(node_id)
+        return {"known": info is not None and info["state"] == "ALIVE"}
 
     async def handle_ReportResources(self, req):
         node = self.nodes.get(req["node_id"])
@@ -260,13 +426,18 @@ class GcsServer:
 
     async def handle_KVPut(self, req):
         added = self.kv.put(req["ns"], req["key"], req["value"], req.get("overwrite", True))
+        if added:
+            self._persist("kv", [req["ns"], req["key"], req["value"]])
         return {"added": added}
 
     async def handle_KVGet(self, req):
         return {"value": self.kv.get(req["ns"], req["key"])}
 
     async def handle_KVDel(self, req):
-        return {"deleted": self.kv.delete(req["ns"], req["key"])}
+        deleted = self.kv.delete(req["ns"], req["key"])
+        if deleted:
+            self._persist("kv", [req["ns"], req["key"], None])
+        return {"deleted": deleted}
 
     async def handle_KVKeys(self, req):
         return {"keys": self.kv.keys(req["ns"], req.get("prefix", b""))}
@@ -287,7 +458,9 @@ class GcsServer:
     async def handle_PubsubPoll(self, req):
         timeout = min(req.get("timeout", 30.0), RTPU_CONFIG.pubsub_poll_timeout_s)
         batch = await self.pubsub.poll(req["sub_id"], timeout)
-        return {"batch": batch}
+        # Epoch lets pollers detect a GCS restart (subscriber state is
+        # process-local) and re-subscribe their channels.
+        return {"batch": batch, "epoch": self.epoch}
 
     async def handle_Publish(self, req):
         self.pubsub.publish(req["channel"], req["message"])
@@ -306,6 +479,7 @@ class GcsServer:
             "metadata": req.get("metadata", {}),
             "driver_sys_path": req.get("driver_sys_path", []),
         }
+        self._persist("job", self.jobs[req["job_id"]])
         self.pubsub.publish("job", {"job_id": req["job_id"], "state": "RUNNING"})
         return {"ok": True}
 
@@ -318,6 +492,7 @@ class GcsServer:
         if job:
             job["state"] = "FINISHED"
             job["end_time"] = time.time()
+            self._persist("job", job)
         self.pubsub.publish("job", {"job_id": req["job_id"], "state": "FINISHED"})
         # Tell raylets to reap this job's workers.
         for nid in self.alive_nodes():
@@ -348,6 +523,7 @@ class GcsServer:
                 if self.actors.get(existing, {}).get("state") != DEAD:
                     raise ValueError(f"actor name '{name}' already taken")
             self.named_actors[(ns, name)] = actor_id
+            self._persist("named", [ns, name, actor_id])
         self.actors[actor_id] = {
             "actor_id": actor_id,
             "state": PENDING_CREATION,
@@ -365,6 +541,7 @@ class GcsServer:
             "death_cause": "",
             "start_time": time.time(),
         }
+        self._persist_actor(self.actors[actor_id])
         self.pending_actor_queue.append(actor_id)
         asyncio.ensure_future(self._schedule_pending_actors())
         return {"ok": True}
@@ -468,6 +645,8 @@ class GcsServer:
         return True
 
     def _publish_actor(self, actor_id: bytes, rec: dict):
+        # Every state transition flows through here: persist alongside publish.
+        self._persist_actor(rec)
         msg = {
             "actor_id": actor_id,
             "state": rec["state"],
@@ -573,6 +752,7 @@ class GcsServer:
             name = rec.get("name")
             if name:
                 self.named_actors.pop((rec.get("namespace", ""), name), None)
+                self._persist("named", [rec.get("namespace", ""), name, None])
             self._publish_actor(actor_id, rec)
         return {"ok": True}
 
@@ -593,6 +773,7 @@ class GcsServer:
             "owner_worker_id": req.get("owner_worker_id"),
             "ready_event": None,
         }
+        self._persist_pg(self.placement_groups[pg_id])
         self.pending_pg_queue.append(pg_id)
         asyncio.ensure_future(self._schedule_pending_pgs())
         return {"ok": True}
@@ -755,6 +936,7 @@ class GcsServer:
                 bundle["node_id"] = None
             return False
         pg["state"] = "CREATED"
+        self._persist_pg(pg)
         if pg.get("ready_event") is not None:
             pg["ready_event"].set()
         self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
@@ -814,6 +996,7 @@ class GcsServer:
                 except Exception:
                     pass
         pg["state"] = "REMOVED"
+        self._persist_pg(pg)
         if pg.get("ready_event") is not None:
             pg["ready_event"].set()  # wake waiters; they observe REMOVED
         self.pubsub.publish("pg", {"pg_id": pg_id, "state": "REMOVED"})

@@ -15,6 +15,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import uuid
 from typing import Dict, Optional
@@ -74,9 +75,16 @@ class Node:
         self.processes: Dict[str, subprocess.Popen] = {}
         self.gcs_address = gcs_address
         self.raylet_port: Optional[int] = None
+        self.gcs_port: Optional[int] = None
+        self._shutting_down = False
+        self._gcs_monitor: Optional[threading.Thread] = None
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         if head:
             self._start_gcs()
+            self._gcs_monitor = threading.Thread(
+                target=self._monitor_gcs, name="gcs-monitor", daemon=True
+            )
+            self._gcs_monitor.start()
         self._start_raylet()
 
     def _log_files(self, name: str):
@@ -92,21 +100,54 @@ class Node:
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         return env
 
-    def _start_gcs(self):
+    def _start_gcs(self, port: int = 0):
         port_file = os.path.join(self.session_dir, f"gcs_port_{self.node_name}")
+        # Always clear the stale port file: on a fixed-port restart a
+        # leftover file would make _wait_port_file report success even when
+        # the new GCS died at startup.
+        if os.path.exists(port_file):
+            os.remove(port_file)
         out, err = self._log_files("gcs_server")
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "ray_tpu._private.gcs.server",
                 f"--host={self.host}",
+                f"--port={port}",
                 f"--session-dir={self.session_dir}",
                 f"--port-file={port_file}",
             ],
             stdout=out, stderr=err, env=self._env(), start_new_session=True,
         )
         self.processes["gcs_server"] = proc
-        port = _wait_port_file(port_file, proc)
-        self.gcs_address = f"{self.host}:{port}"
+        self.gcs_port = _wait_port_file(port_file, proc)
+        self.gcs_address = f"{self.host}:{self.gcs_port}"
+
+    def _monitor_gcs(self):
+        """Restart the GCS if it dies unexpectedly (same port, same log).
+
+        The GCS replays <session_dir>/gcs.log on startup and the cluster
+        resumes: raylets/workers retry their connections and re-register
+        (reference: GCS fault tolerance via Redis persistence + client-side
+        gcs_rpc_server_reconnect_timeout_s).
+        """
+        backoff = 0.5
+        while not self._shutting_down:
+            proc = self.processes.get("gcs_server")
+            if proc is not None and proc.poll() is not None and not self._shutting_down:
+                try:
+                    self._start_gcs(port=self.gcs_port or 0)
+                    backoff = 0.5
+                except Exception:
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 10.0)
+                    continue
+                if self._shutting_down:
+                    # shutdown() raced our restart; don't leak the new GCS.
+                    try:
+                        self.processes["gcs_server"].kill()
+                    except Exception:
+                        pass
+            time.sleep(0.2)
 
     def _start_raylet(self):
         port_file = os.path.join(self.session_dir, f"raylet_port_{self.node_name}")
@@ -141,7 +182,18 @@ class Node:
             if name.startswith("raylet"):
                 proc.kill()
 
+    def kill_gcs(self):
+        """Fault-injection: kill -9 the GCS (the monitor restarts it)."""
+        proc = self.processes.get("gcs_server")
+        if proc is not None:
+            proc.kill()
+
     def shutdown(self):
+        self._shutting_down = True
+        if self._gcs_monitor is not None and self._gcs_monitor.is_alive():
+            # Let an in-flight restart finish (and self-reap) before we
+            # sweep self.processes, so no freshly-spawned GCS escapes.
+            self._gcs_monitor.join(timeout=5.0)
         for proc in self.processes.values():
             try:
                 proc.terminate()

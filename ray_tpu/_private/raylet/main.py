@@ -110,18 +110,7 @@ class NodeManager:
         # Warm the fork server immediately so the first lease forks in ~ms
         # (reference: worker_pool.h:359 PrestartWorkers).
         asyncio.ensure_future(self.worker_pool._ensure_fork_server())
-        await self.gcs.call(
-            "RegisterNode",
-            {
-                "node_id": self.node_id.binary(),
-                "ip": self.host,
-                "raylet_port": port,
-                "plasma_name": self.plasma_name,
-                "resources": self.total.to_dict(),
-                "labels": self.labels,
-                "is_head": self.is_head,
-            },
-        )
+        await self._register_node()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reaper_loop()))
         self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
@@ -131,13 +120,35 @@ class NodeManager:
         )
         return port
 
+    async def _register_node(self):
+        await self.gcs.call(
+            "RegisterNode",
+            {
+                "node_id": self.node_id.binary(),
+                "ip": self.host,
+                "raylet_port": self.port,
+                "plasma_name": self.plasma_name,
+                "resources": self.total.to_dict(),
+                "labels": self.labels,
+                "is_head": self.is_head,
+            },
+        )
+
     async def _heartbeat_loop(self):
         period = RTPU_CONFIG.health_check_period_ms / 1000.0
         report_period = RTPU_CONFIG.resource_report_period_ms / 1000.0
         last_report = 0.0
         while True:
             try:
-                await self.gcs.notify("Heartbeat", {"node_id": self.node_id.binary()})
+                beat = await self.gcs.call(
+                    "Heartbeat", {"node_id": self.node_id.binary()}, timeout=10
+                )
+                if beat is not None and not beat.get("known", True):
+                    # The GCS restarted without our registration (persistence
+                    # off or state lost): re-register so the cluster resumes.
+                    logger.warning("GCS lost our registration; re-registering")
+                    await self._register_node()
+                    self._resources_dirty = True
                 now = time.time()
                 if self._resources_dirty or now - last_report > report_period * 4:
                     await self.gcs.notify(

@@ -194,6 +194,7 @@ class CoreWorker:
         self._leases: Dict[tuple, _LeaseState] = {}
         self._pending_tasks: Dict[bytes, dict] = {}  # task_id -> record
         self._actor_submitters: Dict[bytes, _ActorSubmitter] = {}
+        self._subscribed_channels: set = set()
         self._running_async: Dict[bytes, Any] = {}  # task_id -> cancellable future
         self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
         self._node_cache: Dict[bytes, dict] = {}
@@ -1202,13 +1203,37 @@ class CoreWorker:
     async def _watch_actor(self, actor_id: bytes):
         sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
         channel = f"actor:{actor_id.hex()}"
+        self._subscribed_channels.add(channel)
         await self.gcs_aio.call(
             "Subscribe", {"sub_id": self.worker_id.binary(), "channel": channel}
         )
         await self._refresh_actor_state(sub)
 
+    async def _resubscribe_after_gcs_restart(self) -> bool:
+        """The GCS restarted (new epoch): its subscriber table is gone.
+
+        Re-subscribe every channel we were watching and re-read actor states
+        we may have missed while the GCS was down. Returns False if any
+        re-subscribe failed (a flapping GCS) so the caller keeps the old
+        epoch and retries on the next poll.
+        """
+        ok = True
+        for channel in list(self._subscribed_channels):
+            try:
+                await self.gcs_aio.call(
+                    "Subscribe",
+                    {"sub_id": self.worker_id.binary(), "channel": channel},
+                )
+            except Exception:
+                ok = False
+        for sub in list(self._actor_submitters.values()):
+            if sub.state != "DEAD":
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+        return ok
+
     async def _pubsub_loop(self):
         """Single long-poll loop draining every GCS channel we subscribe to."""
+        epoch = None
         while True:
             try:
                 reply = await self.gcs_aio.call(
@@ -1219,6 +1244,11 @@ class CoreWorker:
             except Exception:
                 await asyncio.sleep(1.0)
                 continue
+            new_epoch = reply.get("epoch")
+            if epoch is None or new_epoch == epoch:
+                epoch = new_epoch
+            elif await self._resubscribe_after_gcs_restart():
+                epoch = new_epoch
             for channel, msg in reply.get("batch", []):
                 if channel.startswith("actor:"):
                     actor_id = msg["actor_id"]
