@@ -22,6 +22,16 @@ _FLAGS: Dict[str, Any] = {
     "object_store_memory": 2 * 1024**3,
     # Chunk size for node-to-node object transfer.
     "object_manager_chunk_size": 4 * 1024**2,
+    # --- object spilling / memory pressure ---------------------------------
+    # Watermark: spill pinned primaries to disk when plasma use crosses this
+    # fraction (reference: object_spilling_threshold).
+    "object_spilling_threshold": 0.8,
+    "object_spilling_check_period_ms": 500,
+    # Node memory fraction beyond which the raylet kills a worker to avert
+    # host OOM (reference: memory_monitor.h memory_usage_threshold). Set
+    # memory_monitor_refresh_ms to 0 to disable.
+    "memory_usage_threshold": 0.95,
+    "memory_monitor_refresh_ms": 250,
     # --- scheduling --------------------------------------------------------
     # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
     "scheduler_spread_threshold": 0.5,

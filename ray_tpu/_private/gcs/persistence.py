@@ -77,17 +77,32 @@ class GcsLog:
                     return
                 yield kind, data
 
-    def compact(self, records: List[Tuple[str, object]]) -> None:
-        """Atomically replace the log with a snapshot of current state."""
+    @staticmethod
+    def pack(records: List[Tuple[str, object]]) -> bytes:
+        """Serialize records to the framed on-disk form (caller's thread)."""
+        out = []
+        for kind, data in records:
+            body = msgpack.packb([kind, data], use_bin_type=True)
+            out.append(_LEN.pack(len(body)) + body)
+        return b"".join(out)
+
+    def compact_packed(self, blob: bytes) -> None:
+        """Atomically replace the log with pre-packed snapshot bytes.
+
+        Safe to run in a worker thread: the caller packs on the event loop
+        (point-in-time consistent), only the write+fsync happens here.
+        """
         self.close()
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
-            for kind, data in records:
-                body = msgpack.packb([kind, data], use_bin_type=True)
-                f.write(_LEN.pack(len(body)) + body)
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+
+    def compact(self, records: List[Tuple[str, object]]) -> None:
+        """Atomically replace the log with a snapshot of current state."""
+        self.compact_packed(self.pack(records))
 
     def size(self) -> int:
         try:

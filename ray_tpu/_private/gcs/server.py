@@ -287,12 +287,14 @@ class GcsServer:
             await asyncio.sleep(5.0)
             if self.log is None or self.log.size() <= limit or self._compacting:
                 continue
-            records = self._snapshot_records()
+            # Pack on the loop (consistent point-in-time view of the live
+            # table dicts); only the write+fsync goes to the thread.
+            blob = GcsLog.pack(self._snapshot_records())
             self._compacting = True
             self._compact_buffer = []
             try:
                 await asyncio.get_running_loop().run_in_executor(
-                    None, self.log.compact, records
+                    None, self.log.compact_packed, blob
                 )
             except Exception:
                 logger.exception("gcs log compaction failed")
@@ -520,7 +522,10 @@ class GcsServer:
         if name:
             if (ns, name) in self.named_actors:
                 existing = self.named_actors[(ns, name)]
-                if self.actors.get(existing, {}).get("state") != DEAD:
+                # existing == actor_id: a client retry of our own
+                # registration after a GCS failover — idempotent, not a
+                # collision.
+                if existing != actor_id and self.actors.get(existing, {}).get("state") != DEAD:
                     raise ValueError(f"actor name '{name}' already taken")
             self.named_actors[(ns, name)] = actor_id
             self._persist("named", [ns, name, actor_id])

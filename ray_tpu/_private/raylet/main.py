@@ -27,7 +27,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu._native.plasma import PlasmaClient
+from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import accelerators
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.gcs.client import GcsAioClient
@@ -91,6 +91,16 @@ class NodeManager:
         self._pulls: Dict[bytes, asyncio.Event] = {}
         # pinned primary copies: object_id bytes -> memoryview
         self._pinned: Dict[bytes, memoryview] = {}
+        # spilled primaries: object_id bytes -> (path, size). A spilled object
+        # may ALSO be in plasma (restored); then re-spilling is a free drop.
+        # (reference: raylet/local_object_manager.h:41 spill/restore)
+        self._spilled: Dict[bytes, Tuple[str, int]] = {}
+        self._spill_dir = os.path.join(
+            session_dir or ".", f"spilled_{node_id.hex()[:12]}"
+        )
+        self._spill_lock = asyncio.Lock()
+        # worker_id -> reason, for deaths we caused (OOM kills)
+        self._kill_reasons: Dict[bytes, str] = {}
         self._bg = []
 
     # ------------------------------------------------------------- lifecycle
@@ -114,6 +124,8 @@ class NodeManager:
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reaper_loop()))
         self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
+        self._bg.append(asyncio.ensure_future(self._spill_loop()))
+        self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id.hex()[:12], self.host, port, self.total.to_dict(),
@@ -190,13 +202,14 @@ class NodeManager:
                 self._release_lease(lease_id)
         actor_id = self._actor_workers.pop(handle.worker_id, None)
         rc = handle.returncode
+        reason = self._kill_reasons.pop(handle.worker_id, None) or f"exit code {rc}"
         await self.gcs.notify(
             "ReportWorkerDeath",
             {
                 "worker_id": handle.worker_id,
                 "node_id": self.node_id.binary(),
                 "actor_id": actor_id,
-                "reason": f"exit code {rc}",
+                "reason": reason,
             },
         )
 
@@ -489,6 +502,224 @@ class NodeManager:
             self._resources_dirty = True
             self._kick_waiters()
 
+    # ----------------------------------------------------- spilling / OOM
+
+    @staticmethod
+    def _write_spill_file(path: str, data: bytes):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    async def _spill_bytes(self, needed: int) -> int:
+        """Spill pinned primary copies to disk until ``needed`` bytes of
+        plasma are reclaimable. Oldest pins first (insertion order ~= LRU).
+
+        Reference: LocalObjectManager::SpillObjectsOfSize
+        (src/ray/raylet/local_object_manager.h:41). The primary copy moves
+        to <session>/spilled_<node>/<oid>; remote pulls are served straight
+        from the file and local access restores it into plasma on demand.
+        """
+        async with self._spill_lock:
+            victims: List[Tuple[bytes, memoryview]] = []
+            planned = 0
+            for oid, view in list(self._pinned.items()):
+                if planned >= needed:
+                    break
+                victims.append((oid, view))
+                planned += view.nbytes
+            if not victims:
+                return 0
+            os.makedirs(self._spill_dir, exist_ok=True)
+            loop = asyncio.get_running_loop()
+            freed = 0
+            for oid, view in victims:
+                if oid not in self._pinned:
+                    # Freed (handle_FreeObjects) while an earlier victim was
+                    # being written: its view is released — don't touch it.
+                    continue
+                nbytes = view.nbytes  # capture before any await
+                rec = self._spilled.get(oid)
+                if rec is None:
+                    path = os.path.join(self._spill_dir, oid.hex())
+                    try:
+                        data = bytes(view)
+                        await loop.run_in_executor(
+                            None, self._write_spill_file, path, data
+                        )
+                    except Exception:
+                        logger.exception("spill of %s failed", oid.hex()[:12])
+                        continue
+                    if oid not in self._pinned:
+                        # Freed during the write: don't resurrect the entry.
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
+                        continue
+                    self._spilled[oid] = (path, nbytes)
+                self._pinned.pop(oid, None)
+                try:
+                    view.release()
+                except Exception:
+                    pass
+                self.plasma.release(oid)
+                # delete may fail if a reader still holds it; its memory
+                # frees when that reader releases — still progress.
+                self.plasma.delete(oid)
+                freed += nbytes
+            if freed:
+                logger.info(
+                    "spilled %d objects / %d bytes to %s",
+                    len(victims), freed, self._spill_dir,
+                )
+            return freed
+
+    async def _restore_spilled(self, oid: bytes) -> bool:
+        """Bring a spilled object back into local plasma (re-pinned)."""
+        rec = self._spilled.get(oid)
+        if rec is None:
+            return False
+        path, size = rec
+        dest = None
+        for attempt in range(6):
+            try:
+                dest = await self._plasma_create_with_room(oid, size)
+                break
+            except FileExistsError:
+                if self.plasma.contains(oid):
+                    return True  # sealed — someone beat us to it
+                # Unsealed leftover of a crashed restore: reclaim and retry.
+                self.plasma.abort(oid)
+                continue
+            except PlasmaOOM:
+                # Transient: spill victims whose memory is still held by an
+                # in-flight reader free up once that reader releases.
+                await asyncio.sleep(0.1 * (attempt + 1))
+        if dest is None:
+            logger.warning("restore of %s: no room after retries", oid.hex()[:12])
+            return False
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(
+                None, lambda: open(path, "rb").read()
+            )
+            dest[:] = data
+            dest.release()
+            self.plasma.seal(oid)
+        except Exception:
+            logger.exception("restore of %s failed", oid.hex()[:12])
+            try:
+                dest.release()
+            except Exception:
+                pass
+            self.plasma.abort(oid)
+            return False
+        # Primary copy again: re-pin. The spill file stays so a future
+        # re-spill is a free drop; FreeObjects removes it with the object.
+        view = self.plasma.get(oid)
+        if view is not None:
+            self._pinned[oid] = view
+        return True
+
+    async def _plasma_create_with_room(self, oid: bytes, size: int):
+        """plasma create that makes room: evict unpinned, then spill."""
+        try:
+            return self.plasma.create(oid, size)
+        except PlasmaOOM:
+            self.plasma.evict(size)
+        try:
+            return self.plasma.create(oid, size)
+        except PlasmaOOM:
+            await self._spill_bytes(size)
+        return self.plasma.create(oid, size)
+
+    async def handle_SpillObjects(self, req):
+        """A worker hit plasma OOM: free up ``bytes`` by spilling primaries."""
+        freed = await self._spill_bytes(req["bytes"])
+        return {"freed": freed}
+
+    async def _spill_loop(self):
+        """Watermark spilling: keep plasma below the high threshold so task
+        returns never stall on a store packed with pinned primaries."""
+        period = RTPU_CONFIG.object_spilling_check_period_ms / 1000.0
+        high = RTPU_CONFIG.object_spilling_threshold
+        while True:
+            await asyncio.sleep(period)
+            try:
+                if not self._pinned:
+                    continue
+                s = self.plasma.stats()
+                cap = s["capacity_bytes"]
+                if cap and s["used_bytes"] > high * cap:
+                    target = max(0.0, (high - 0.1)) * cap
+                    await self._spill_bytes(int(s["used_bytes"] - target))
+            except Exception:
+                logger.exception("spill loop error")
+
+    # -- OOM monitor (reference: src/ray/common/memory_monitor.h:52 +
+    #    raylet/worker_killing_policy_group_by_owner.h) -------------------
+
+    @staticmethod
+    def _memory_usage_fraction() -> Optional[float]:
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    parts = line.split()
+                    if parts[0] in ("MemTotal:", "MemAvailable:"):
+                        info[parts[0]] = int(parts[1])
+            total = info.get("MemTotal:")
+            avail = info.get("MemAvailable:")
+            if not total or avail is None:
+                return None
+            return 1.0 - avail / total
+        except Exception:
+            return None
+
+    def _pick_oom_victim(self):
+        """Kill-priority: leased task workers (their tasks retry) before
+        actor workers (restart costs state), newest first within a class."""
+        candidates = [
+            h
+            for h in self.worker_pool.workers.values()
+            if h.alive and h.leased and h.pid
+        ]
+        if not candidates:
+            return None
+        candidates.sort(
+            key=lambda h: (
+                h.worker_id in self._actor_workers,  # tasks first
+                -h.startup_token,  # newest first
+            )
+        )
+        return candidates[0]
+
+    async def _memory_monitor_loop(self):
+        period = RTPU_CONFIG.memory_monitor_refresh_ms / 1000.0
+        threshold = RTPU_CONFIG.memory_usage_threshold
+        if period <= 0:
+            return
+        while True:
+            await asyncio.sleep(period)
+            try:
+                frac = self._memory_usage_fraction()
+                if frac is None or frac < threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                reason = (
+                    f"worker killed by the memory monitor: node memory usage "
+                    f"{frac:.2f} exceeded threshold {threshold:.2f} (OOM "
+                    f"prevention; task will be retried if retriable)"
+                )
+                logger.warning("%s (pid=%d)", reason, victim.pid)
+                self._kill_reasons[victim.worker_id] = reason
+                await self.worker_pool.kill_worker(victim)
+            except Exception:
+                logger.exception("memory monitor error")
+
     # --------------------------------------------------------- object plane
 
     async def handle_PinObject(self, req):
@@ -509,24 +740,51 @@ class NodeManager:
                     pass
                 self.plasma.release(oid)
             self.plasma.delete(oid)
+            spilled = self._spilled.pop(oid, None)
+            if spilled is not None:
+                try:
+                    os.remove(spilled[0])
+                except OSError:
+                    pass
 
     async def handle_FetchObjectInfo(self, req):
-        view = self.plasma.get(req["object_id"])
+        oid = req["object_id"]
+        view = self.plasma.get(oid)
         if view is None:
+            # Spilled here: remote pulls are served straight from disk
+            # (reference: spilled-object chunk reader, object_manager/
+            # spilled_object_reader.h) — no plasma round-trip.
+            spilled = self._spilled.get(oid)
+            if spilled is not None:
+                return {"found": True, "size": spilled[1]}
             return {"found": False}
         size = view.nbytes
         view.release()
-        self.plasma.release(req["object_id"])
+        self.plasma.release(oid)
         return {"found": True, "size": size}
 
     async def handle_FetchChunk(self, req):
-        view = self.plasma.get(req["object_id"])
-        if view is None:
-            return {"found": False}
+        oid = req["object_id"]
         off, size = req["offset"], req["size"]
+        view = self.plasma.get(oid)
+        if view is None:
+            spilled = self._spilled.get(oid)
+            if spilled is not None:
+                loop = asyncio.get_running_loop()
+
+                def _read():
+                    with open(spilled[0], "rb") as f:
+                        f.seek(off)
+                        return f.read(size)
+
+                try:
+                    return {"found": True, "data": await loop.run_in_executor(None, _read)}
+                except OSError:
+                    return {"found": False}
+            return {"found": False}
         data = bytes(view[off : off + size])
         view.release()
-        self.plasma.release(req["object_id"])
+        self.plasma.release(oid)
         return {"found": True, "data": data}
 
     async def handle_PullObject(self, req):
@@ -545,7 +803,13 @@ class NodeManager:
         event = asyncio.Event()
         self._pulls[oid] = event
         try:
-            ok = await self._do_pull(oid, req.get("owner_addr"))
+            if oid in self._spilled:
+                # Spilled on this node: restore from disk, deduplicated by
+                # the same in-flight event as remote pulls so concurrent
+                # getters never observe a half-restored (unsealed) object.
+                ok = await self._restore_spilled(oid)
+            else:
+                ok = await self._do_pull(oid, req.get("owner_addr"))
             return {"ok": ok}
         finally:
             event.set()
@@ -583,9 +847,14 @@ class NodeManager:
                     continue
                 size = meta["size"]
                 try:
-                    dest = self.plasma.create(oid, size)
+                    dest = await self._plasma_create_with_room(oid, size)
                 except FileExistsError:
                     return True
+                except PlasmaOOM:
+                    logger.warning(
+                        "pull %s: no room even after spilling", oid.hex()[:12]
+                    )
+                    return False
                 chunk = RTPU_CONFIG.object_manager_chunk_size
                 offset = 0
                 try:

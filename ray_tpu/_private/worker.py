@@ -23,7 +23,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._native.plasma import PlasmaClient
+from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import serialization, task_spec as ts
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.executor import Executor
@@ -38,7 +38,9 @@ from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     OwnerDiedError,
+    RayTpuError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -426,10 +428,30 @@ class CoreWorker:
             # block on it forever. Reclaim and rewrite.
             self.plasma.abort(oid)
             dest = self.plasma.create(oid, size)
-        except Exception:
-            # OOM: evict and retry once
-            self.plasma.evict(size)
-            dest = self.plasma.create(oid, size)
+        except PlasmaOOM:
+            # Make room: evict unpinned secondaries, then ask the raylet to
+            # spill pinned primaries to disk (reference: CreateRequestQueue
+            # retries + LocalObjectManager spilling). Spilled memory may free
+            # only after concurrent readers release their views, so retry
+            # with backoff before giving up.
+            dest = None
+            for attempt in range(6):
+                self.plasma.evict(size)
+                try:
+                    dest = self.plasma.create(oid, size)
+                    break
+                except PlasmaOOM:
+                    try:
+                        self.io.run(
+                            self.raylet.call(
+                                "SpillObjects", {"bytes": size}, timeout=60
+                            )
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.1 * (attempt + 1))
+            if dest is None:
+                dest = self.plasma.create(oid, size)  # raise the real OOM
         try:
             serialization.write_blob(dest, payload["p"], payload["b"])
             dest.release()
@@ -449,8 +471,12 @@ class CoreWorker:
         self.memory_store.put(oid, InPlasma(size, {node}))
         self._object_locations.setdefault(oid.binary(), set()).add(node)
         try:
-            await self.raylet.notify(
-                "PinObject", {"object_id": oid.binary(), "owner_addr": list(self.address)}
+            # Synchronous: until the pin lands, a concurrent put's evict()
+            # could reclaim this primary and lose the object.
+            await self.raylet.call(
+                "PinObject",
+                {"object_id": oid.binary(), "owner_addr": list(self.address)},
+                timeout=30,
             )
         except Exception:
             pass
@@ -463,6 +489,11 @@ class CoreWorker:
         out = []
         for ref, res in zip(refs, resolutions):
             value = self._materialize(ref.object_id(), res)
+            if isinstance(value, ObjectLostError) and res[0] == "plasma_local":
+                # Spilled between resolution and read: resolve again (the
+                # raylet restores it from disk).
+                res = self.io.run(self._async_resolve(ref, deadline))
+                value = self._materialize(ref.object_id(), res)
             if isinstance(value, Exception):
                 raise value
             out.append(value)
@@ -473,6 +504,11 @@ class CoreWorker:
         res = await self._async_resolve(ref, None)
         loop = asyncio.get_running_loop()
         value = await loop.run_in_executor(None, self._materialize, ref.object_id(), res)
+        if isinstance(value, ObjectLostError) and res[0] == "plasma_local":
+            res = await self._async_resolve(ref, None)
+            value = await loop.run_in_executor(
+                None, self._materialize, ref.object_id(), res
+            )
         if isinstance(value, Exception):
             raise value
         return value
@@ -548,17 +584,24 @@ class CoreWorker:
         if self.plasma.contains(oid):
             return ("plasma_local", oid)
         owner_addr = list(owner) if owner else list(self.address)
-        try:
-            timeout = None if deadline is None else max(0.1, deadline - time.time())
-            reply = await self.raylet.call(
-                "PullObject",
-                {"object_id": oid.binary(), "owner_addr": owner_addr},
-                timeout=timeout,
-            )
-        except asyncio.TimeoutError:
-            return ("err_obj", GetTimeoutError(f"get() timed out pulling {oid.hex()}"))
-        if reply.get("ok") and self.plasma.contains(oid):
-            return ("plasma_local", oid)
+        # A pull can fail transiently (restore-from-spill racing store
+        # pressure, holder mid-eviction): retry before declaring the copy
+        # lost — put objects have no lineage to fall back on.
+        for attempt in range(3):
+            try:
+                timeout = None if deadline is None else max(0.1, deadline - time.time())
+                reply = await self.raylet.call(
+                    "PullObject",
+                    {"object_id": oid.binary(), "owner_addr": owner_addr},
+                    timeout=timeout,
+                )
+            except asyncio.TimeoutError:
+                return ("err_obj", GetTimeoutError(f"get() timed out pulling {oid.hex()}"))
+            if reply.get("ok") and self.plasma.contains(oid):
+                return ("plasma_local", oid)
+            if deadline is not None and time.time() >= deadline:
+                break
+            await asyncio.sleep(0.2 * (attempt + 1))
         return ("plasma_remote_lost", oid)
 
     def _materialize(self, oid: ObjectID, res: tuple):
@@ -573,6 +616,11 @@ class CoreWorker:
             return value
         if kind == _ERR:
             exc, _refs = serialization.deserialize_inline(res[1])
+            if isinstance(exc, RayTpuError) and not isinstance(exc, TaskError):
+                # System failures (worker crash, OOM kill, actor death...)
+                # surface as their own type; only user exceptions wrap in
+                # TaskError (reference: RayTaskError vs RaySystemError).
+                return exc
             if isinstance(exc, Exception):
                 return TaskError(exc, getattr(exc, "_rtpu_tb", str(exc)))
             return TaskError(Exception(str(exc)), str(exc))
@@ -953,7 +1001,29 @@ class CoreWorker:
             self.task_events.record(spec, "RETRY")
             await self._submit_normal(spec)
         else:
-            self._fail_task(spec, WorkerCrashedError(f"worker died executing {spec['name']}: {err}"))
+            error: Exception = WorkerCrashedError(
+                f"worker died executing {spec['name']}: {err}"
+            )
+            # If the raylet's memory monitor killed the worker, surface the
+            # real cause (reference: OOM deaths raise ray.exceptions.
+            # OutOfMemoryError, task_manager failure-cause plumbing).
+            lease = (record or {}).get("lease")
+            if lease:
+                try:
+                    await asyncio.sleep(0.3)  # let the death report land
+                    r = await self.gcs_aio.call(
+                        "GetWorkerFailures", {"limit": 200}, timeout=5
+                    )
+                    for f in reversed(r.get("failures", [])):
+                        if f.get("worker_id") == lease["worker_id"]:
+                            if "memory monitor" in f.get("reason", ""):
+                                error = OutOfMemoryError(
+                                    f"task {spec['name']} failed: {f['reason']}"
+                                )
+                            break
+                except Exception:
+                    pass
+            self._fail_task(spec, error)
 
     def _fail_task(self, spec: dict, error: Exception):
         record = self._pending_tasks.pop(spec["task_id"], None)
@@ -1324,9 +1394,10 @@ class CoreWorker:
             None, self._plasma_put_payload, oid, payload
         )
         try:
-            await self.raylet.notify(
+            await self.raylet.call(
                 "PinObject",
                 {"object_id": oid.binary(), "owner_addr": list(spec["owner_addr"])},
+                timeout=30,
             )
         except Exception:
             pass
