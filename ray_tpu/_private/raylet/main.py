@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import accelerators
+from ray_tpu._private import runtime_env as renv
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.gcs.client import GcsAioClient
 from ray_tpu._private.ids import NodeID
@@ -126,6 +127,7 @@ class NodeManager:
         self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
         self._bg.append(asyncio.ensure_future(self._spill_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
+        self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id.hex()[:12], self.host, port, self.total.to_dict(),
@@ -335,12 +337,17 @@ class NodeManager:
                     f"placement group bundle {bundle['reserved'].to_dict()}"
                 )}
 
+        try:
+            env_overrides = await self._runtime_env_overrides(req.get("runtime_env"))
+        except Exception as e:
+            return {"error": f"runtime_env setup failed: {e}"}
+
         while True:
             if is_pg and pg_key not in self.bundles:
                 return {"error": "placement group removed"}
             grant = self._try_acquire(resources, strategy)
             if grant is not None:
-                handle = await self.worker_pool.pop_worker(job_id)
+                handle = await self.worker_pool.pop_worker(job_id, env_overrides)
                 if handle is None:
                     # worker failed to start; release and retry
                     pool, _ = self._pool_for(strategy)
@@ -426,7 +433,12 @@ class NodeManager:
         grant = self._try_acquire(req["resources"], req.get("strategy", {}))
         if grant is None:
             return {"granted": False}
-        env = {}
+        try:
+            env = await self._runtime_env_overrides(req.get("runtime_env"))
+        except Exception as e:
+            pool, _ = self._pool_for(req.get("strategy", {}))
+            pool.release(grant["demand"])
+            return {"granted": False, "error": f"runtime_env setup failed: {e}"}
         num_tpu = req["resources"].get("TPU", 0)
         if num_tpu and num_tpu == int(num_tpu):
             env.update(accelerators.visible_chip_env(range(int(num_tpu))))
@@ -451,6 +463,38 @@ class NodeManager:
             "worker_id": handle.worker_id,
             "lease_id": lease_id,
         }
+
+    async def _runtime_env_overrides(self, runtime_env) -> Dict[str, str]:
+        """Turn a spec's runtime_env into worker env overrides, extracting an
+        uploaded working_dir on first use (reference: the per-node
+        runtime-env agent, _private/runtime_env/agent/runtime_env_agent.py)."""
+        env: Dict[str, str] = {}
+        if not runtime_env:
+            return env
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            env[str(k)] = str(v)
+        wd = runtime_env.get("working_dir")
+        if wd:
+            if renv.is_uploaded(wd):
+                base = self.session_dir or "."
+                target = renv.materialized_path(wd, base)
+                if not os.path.isdir(target):
+                    digest = wd[len(renv.URI_PREFIX):]
+                    r = await self.gcs.call(
+                        "KVGet", {"ns": renv.KV_NAMESPACE, "key": digest.encode()}
+                    )
+                    blob = r.get("value")
+                    if blob is None:
+                        raise RuntimeError(f"working_dir {wd} missing from GCS KV")
+                    loop = asyncio.get_running_loop()
+                    target = await loop.run_in_executor(
+                        None, renv.extract_working_dir, wd, blob, base
+                    )
+                env[renv.WORKING_DIR_ENV] = target
+            else:
+                # Raw local path (same-machine clusters / tests).
+                env[renv.WORKING_DIR_ENV] = str(wd)
+        return env
 
     async def handle_KillWorker(self, req):
         handle = self.worker_pool.workers.get(req["worker_id"])
@@ -720,6 +764,80 @@ class NodeManager:
             except Exception:
                 logger.exception("memory monitor error")
 
+    # ------------------------------------------------------------ log monitor
+
+    async def _log_monitor_loop(self):
+        """Tail this node's worker logs and publish new lines over GCS
+        pubsub to the owning job's driver (reference:
+        python/ray/_private/log_monitor.py:103 — per-node monitor feeding
+        the driver's log stream)."""
+        tracked: Dict[str, dict] = {}  # path -> {off,job,pid,err,last_growth}
+
+        async def _publish(t, lines):
+            await self.gcs.notify(
+                "Publish",
+                {
+                    "channel": f"logs:{t['job'].hex()}",
+                    "message": {
+                        "pid": t["pid"],
+                        "ip": self.host,
+                        "is_err": t["err"],
+                        "lines": lines,
+                    },
+                },
+            )
+
+        while True:
+            await asyncio.sleep(0.25)
+            try:
+                now = time.time()
+                live_paths = set()
+                for h in list(self.worker_pool.workers.values()):
+                    if not h.log_prefix:
+                        continue
+                    for suffix, is_err in ((".out", False), (".err", True)):
+                        path = h.log_prefix + suffix
+                        live_paths.add(path)
+                        tracked.setdefault(
+                            path,
+                            {"off": 0, "job": h.job_id, "pid": h.pid,
+                             "err": is_err, "last_growth": now},
+                        )
+                for path, t in list(tracked.items()):
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        size = 0
+                    if size <= t["off"]:
+                        # Drop only files of DEPARTED workers once drained —
+                        # a live worker's entry must persist or its path
+                        # would be re-registered at off=0 and replayed.
+                        if path not in live_paths and now - t["last_growth"] > 10.0:
+                            tracked.pop(path, None)
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(t["off"])
+                        data = f.read(min(size - t["off"], 1 << 20))
+                    # Hold back a trailing partial line (mid-print or the
+                    # 1 MiB cap landing mid-line) until its newline arrives;
+                    # flush it anyway once the worker is gone.
+                    cut = data.rfind(b"\n")
+                    if cut < 0:
+                        if path in live_paths:
+                            continue
+                        cut = len(data) - 1
+                    data = data[: cut + 1]
+                    t["off"] += len(data)
+                    t["last_growth"] = now
+                    lines = [
+                        ln.decode("utf-8", "replace")
+                        for ln in data.splitlines()
+                    ]
+                    if lines:
+                        await _publish(t, lines)
+            except Exception:
+                logger.exception("log monitor error")
+
     # --------------------------------------------------------- object plane
 
     async def handle_PinObject(self, req):
@@ -889,6 +1007,50 @@ class NodeManager:
             except Exception as e:
                 logger.warning("pull %s from %s failed: %s", oid.hex()[:12], loc.hex()[:12], e)
         return False
+
+    async def handle_GetLocalObjectInfo(self, req):
+        """State-API source: this node's plasma + spilled objects."""
+        objects = []
+        seen = set()
+        for oid in self.plasma.list_object_ids():
+            b = oid.binary()
+            seen.add(b)
+            size = None
+            view = self.plasma.get(b)
+            if view is not None:
+                size = view.nbytes
+                view.release()
+                self.plasma.release(b)
+            objects.append(
+                {
+                    "object_id": b,
+                    "size": size,
+                    "pinned": b in self._pinned,
+                    "spilled": b in self._spilled,
+                }
+            )
+        for oid, (path, size) in self._spilled.items():
+            if oid not in seen:
+                objects.append(
+                    {"object_id": oid, "size": size, "pinned": False, "spilled": True}
+                )
+        return {"objects": objects}
+
+    async def handle_GetLocalWorkerInfo(self, req):
+        """State-API source: live worker processes on this node."""
+        workers = []
+        for h in self.worker_pool.workers.values():
+            workers.append(
+                {
+                    "worker_id": h.worker_id,
+                    "pid": h.pid,
+                    "job_id": h.job_id,
+                    "leased": h.leased,
+                    "actor_id": self._actor_workers.get(h.worker_id, b""),
+                    "alive": h.alive,
+                }
+            )
+        return {"workers": workers}
 
     async def handle_Ping(self, req):
         return {"ok": True}

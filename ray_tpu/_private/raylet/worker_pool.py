@@ -39,6 +39,10 @@ class WorkerHandle:
     returncode: Optional[int] = None
     idle_since: float = field(default_factory=time.time)
     register_event: Optional[asyncio.Event] = None
+    # canonical runtime-env key: idle reuse only pairs identical envs
+    # (reference: worker_pool.h keys pooled workers by runtime_env_hash)
+    env_key: str = ""
+    log_prefix: str = ""  # session-dir path stem of this worker's .out/.err
 
 
 class WorkerPool:
@@ -166,6 +170,14 @@ class WorkerPool:
 
     # -------------------------------------------------------------- spawning
 
+    @staticmethod
+    def _env_key(env_overrides) -> str:
+        if not env_overrides:
+            return ""
+        # JSON, not delimiter-joining: raw values may contain ';'/'=' and
+        # must not let distinct environments collide onto one pooled worker.
+        return json.dumps(sorted(env_overrides.items()))
+
     async def start_worker(self, job_id: bytes, env_overrides=None) -> WorkerHandle:
         await self._ensure_fork_server()
         token = self._next_token
@@ -174,7 +186,9 @@ class WorkerPool:
         handle = WorkerHandle(
             worker_id=b"", pid=0, job_id=job_id,
             startup_token=token, register_event=asyncio.Event(),
+            env_key=self._env_key(env_overrides),
         )
+        handle.log_prefix = log_prefix
         self._starting[token] = handle
         await self._fs_send(
             {
@@ -203,12 +217,12 @@ class WorkerPool:
 
     async def pop_worker(self, job_id: bytes, env_overrides=None) -> Optional[WorkerHandle]:
         """Get an idle worker for the job or fork a fresh one. Awaits registration."""
-        if not env_overrides:
-            for i, h in enumerate(self._idle):
-                if h.job_id == job_id and h.alive:
-                    self._idle.pop(i)
-                    h.leased = True
-                    return h
+        env_key = self._env_key(env_overrides)
+        for i, h in enumerate(self._idle):
+            if h.job_id == job_id and h.alive and h.env_key == env_key:
+                self._idle.pop(i)
+                h.leased = True
+                return h
         try:
             handle = await self.start_worker(job_id, env_overrides)
         except Exception:

@@ -120,8 +120,57 @@ def return_object_ids(spec: dict) -> List[ObjectID]:
 
 
 def scheduling_key(spec: dict) -> tuple:
-    """Leases are cached per (function, resource shape, strategy) like the
-    reference's SchedulingKey (reference: normal_task_submitter.h)."""
+    """Leases are cached per (function, resource shape, strategy, runtime
+    env) like the reference's SchedulingKey (reference:
+    normal_task_submitter.h — runtime_env_hash is part of the key so tasks
+    with different environments never share a leased worker)."""
     res = tuple(sorted(spec["resources"].items()))
     strat = tuple(sorted((k, str(v)) for k, v in spec["strategy"].items()))
-    return (spec["fn_key"], res, strat)
+    return (spec["fn_key"], res, strat, runtime_env_key(spec.get("runtime_env")))
+
+
+RUNTIME_ENV_SUPPORTED = ("env_vars", "working_dir")
+
+
+def runtime_env_key(runtime_env: Optional[dict]) -> str:
+    """Canonical string form; '' for the default environment. JSON so
+    values containing separator characters cannot make two distinct
+    environments share a scheduling key / pooled worker."""
+    if not runtime_env:
+        return ""
+    import json
+
+    env_vars = runtime_env.get("env_vars") or {}
+    return json.dumps(
+        {"env_vars": dict(sorted(env_vars.items())),
+         "working_dir": runtime_env.get("working_dir") or ""},
+        sort_keys=True,
+    )
+
+
+def validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Reject unsupported runtime_env fields loudly.
+
+    The reference supports many plugins (pip/conda/container/... —
+    python/ray/_private/runtime_env/plugin.py); this framework implements
+    env_vars and working_dir. Accepting-and-ignoring an option would be a
+    silent no-op, which is worse than an error.
+    """
+    if not runtime_env:
+        return runtime_env
+    unknown = set(runtime_env) - set(RUNTIME_ENV_SUPPORTED)
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env field(s) {sorted(unknown)}; "
+            f"supported: {list(RUNTIME_ENV_SUPPORTED)}"
+        )
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()
+        ):
+            raise ValueError("runtime_env env_vars must be a Dict[str, str]")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not isinstance(wd, str):
+        raise ValueError("runtime_env working_dir must be a path string")
+    return runtime_env

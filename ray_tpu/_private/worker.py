@@ -24,7 +24,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
-from ray_tpu._private import serialization, task_spec as ts
+from ray_tpu._private import runtime_env as renv, serialization, task_spec as ts
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.executor import Executor
 from ray_tpu._private.function_manager import FunctionManager
@@ -197,6 +197,7 @@ class CoreWorker:
         self._pending_tasks: Dict[bytes, dict] = {}  # task_id -> record
         self._actor_submitters: Dict[bytes, _ActorSubmitter] = {}
         self._subscribed_channels: set = set()
+        self._working_dir_uris: Dict[tuple, str] = {}  # (path, signature) -> kv uri
         self._running_async: Dict[bytes, Any] = {}  # task_id -> cancellable future
         self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
         self._node_cache: Dict[bytes, dict] = {}
@@ -729,6 +730,7 @@ class CoreWorker:
         runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         fn_key = self.functions.export(fn)
+        runtime_env = self.prepare_runtime_env(runtime_env)
         wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
         big_refs = self._replace_large_args(wire, large)
         refs.extend(big_refs)
@@ -752,6 +754,28 @@ class CoreWorker:
         return_refs = self._register_pending(spec, refs)
         self.io.post(self._submit_normal(spec))
         return return_refs
+
+    def prepare_runtime_env(self, runtime_env: Optional[dict]) -> Optional[dict]:
+        """Validate and materialize a runtime_env for shipping in a spec.
+
+        A local working_dir path is zipped and uploaded to the GCS KV once
+        per content hash (reference: runtime_env/packaging.py); the spec
+        carries the kv:<hash> URI so any node can extract it.
+        """
+        runtime_env = ts.validate_runtime_env(runtime_env)
+        if not runtime_env:
+            return runtime_env
+        wd = runtime_env.get("working_dir")
+        if wd and not renv.is_uploaded(wd):
+            # Cache by content signature, not path: edits to the directory
+            # between submits must produce a fresh upload.
+            cache_key = (os.path.abspath(wd), renv.dir_signature(wd))
+            uri = self._working_dir_uris.get(cache_key)
+            if uri is None:
+                uri = renv.upload_working_dir(self.gcs, wd)
+                self._working_dir_uris[cache_key] = uri
+            runtime_env = {**runtime_env, "working_dir": uri}
+        return runtime_env
 
     def _replace_large_args(self, wire, large) -> List[ObjectRef]:
         """Oversized inline args are put() first and passed by ref
@@ -844,6 +868,7 @@ class CoreWorker:
                         "resources": sample["resources"],
                         "strategy": sample["strategy"],
                         "job_id": sample["job_id"],
+                        "runtime_env": sample.get("runtime_env") or {},
                     },
                     timeout=RTPU_CONFIG.worker_lease_timeout_ms / 1000.0 + 10,
                 )
@@ -1116,6 +1141,7 @@ class CoreWorker:
     ) -> bytes:
         actor_id = ActorID.of(self.job_id)
         fn_key = self.functions.export(cls)
+        runtime_env = self.prepare_runtime_env(runtime_env)
         wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
         big_refs = self._replace_large_args(wire, large)
         refs.extend(big_refs)
@@ -1270,6 +1296,28 @@ class CoreWorker:
             sub.state = state
             sub.addr = None
 
+    @staticmethod
+    def _print_worker_log(msg: dict):
+        """Driver-side sink of the per-node log monitors (reference:
+        worker.py print_to_stdstream — '(pid=, ip=)'-prefixed relay)."""
+        import sys as _sys
+
+        stream = _sys.stderr if msg.get("is_err") else _sys.stdout
+        prefix = f"(pid={msg.get('pid')}, ip={msg.get('ip')})"
+        for line in msg.get("lines", []):
+            print(f"{prefix} {line}", file=stream)
+
+    def enable_log_to_driver(self):
+        """Stream worker stdout/stderr of this job to the driver."""
+        channel = f"logs:{self.job_id.binary().hex()}"
+        self._subscribed_channels.add(channel)
+        self.io.run(
+            self.gcs_aio.call(
+                "Subscribe",
+                {"sub_id": self.worker_id.binary(), "channel": channel},
+            )
+        )
+
     async def _watch_actor(self, actor_id: bytes):
         sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
         channel = f"actor:{actor_id.hex()}"
@@ -1320,7 +1368,9 @@ class CoreWorker:
             elif await self._resubscribe_after_gcs_restart():
                 epoch = new_epoch
             for channel, msg in reply.get("batch", []):
-                if channel.startswith("actor:"):
+                if channel.startswith("logs:"):
+                    self._print_worker_log(msg)
+                elif channel.startswith("actor:"):
                     actor_id = msg["actor_id"]
                     sub = self._actor_submitters.get(actor_id)
                     if sub is not None:

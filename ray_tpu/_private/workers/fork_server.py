@@ -47,6 +47,16 @@ def _child_main(args, spawn):
     os.setsid()
     for k, v in (spawn.get("env") or {}).items():
         os.environ[k] = str(v)
+    # runtime_env working_dir: run user code from the materialized directory
+    # with it importable (reference: runtime_env working_dir semantics —
+    # cwd + sys.path entry).
+    wd = os.environ.get("RTPU_WORKING_DIR")
+    if wd:
+        try:
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+        except OSError:
+            print(f"runtime_env: cannot enter working_dir {wd!r}", file=sys.stderr)
     # If jax was preimported (by us or a plugin), its platform config may
     # have been baked at import time — some platform plugins even force
     # their own value, ignoring the env. Re-sync from the (inherited +

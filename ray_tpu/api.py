@@ -127,6 +127,8 @@ def init(
                 "driver_sys_path": [p for p in _sys.path if p],
             },
         )
+        if log_to_driver:
+            worker.enable_log_to_driver()
         atexit.register(shutdown)
         return _ClientContext(gcs_address)
 
