@@ -157,6 +157,9 @@ class GcsServer:
         self.jobs: Dict[bytes, dict] = {}
         self.task_events: List[dict] = []
         self._worker_failures: List[dict] = []
+        # (name, sorted-label-items) -> aggregated user-metric record
+        self.user_metrics: Dict[Tuple[str, tuple], dict] = {}
+        self.metrics_port = 0
         self._bg_tasks = []
 
     # ------------------------------------------------------------------ util
@@ -310,6 +313,15 @@ class GcsServer:
         self._restore()
         self.server.register_all(self)
         port = await self.server.start(port)
+        try:
+            from ray_tpu._private.metrics import start_metrics_http_server
+
+            self.metrics_server, self.metrics_port = await start_metrics_http_server(
+                self.host, self._collect_metrics
+            )
+        except Exception:
+            logger.exception("metrics endpoint failed to start")
+            self.metrics_port = 0
         self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._compaction_loop()))
         if self.pending_actor_queue:
@@ -374,6 +386,7 @@ class GcsServer:
             "state": "ALIVE",
             "start_time": time.time(),
             "is_head": bool(req.get("is_head")),
+            "metrics_port": req.get("metrics_port", 0),
         }
         self.node_last_beat[node_id] = time.time()
         self._persist("node", self.nodes[node_id])
@@ -625,6 +638,14 @@ class GcsServer:
             logger.warning("actor lease on %s failed: %s", node_id.hex(), e)
             return False
         if not reply.get("granted"):
+            if reply.get("error"):
+                # Deterministic failure (e.g. runtime_env setup): retrying
+                # forever would hang the caller silently — kill the actor
+                # with the cause instead.
+                rec["state"] = DEAD
+                rec["death_cause"] = reply["error"]
+                self._publish_actor(actor_id, rec)
+                return True
             return False
         worker_addr = tuple(reply["worker_addr"])
         worker_id = reply["worker_id"]
@@ -682,6 +703,18 @@ class GcsServer:
     async def handle_ReportWorkerDeath(self, req):
         """Raylet tells us a worker process exited; may host an actor."""
         actor_id = req.get("actor_id")
+        # Prune the dead worker's GAUGE series: a frozen instantaneous value
+        # exported forever poisons aggregations. Counters/histograms stay —
+        # they are cumulative totals that remain true.
+        wid = req.get("worker_id")
+        if wid:
+            wid_short = wid.hex()[:12] if isinstance(wid, bytes) else str(wid)[:12]
+            for key, rec in list(self.user_metrics.items()):
+                if (
+                    rec["kind"] == "gauge"
+                    and rec["labels"].get("WorkerId") == wid_short
+                ):
+                    del self.user_metrics[key]
         self._worker_failures.append(
             {"worker_id": req.get("worker_id"), "node_id": req.get("node_id"),
              "time": time.time(), "reason": req.get("reason", "")}
@@ -1029,8 +1062,76 @@ class GcsServer:
     async def handle_GetWorkerFailures(self, req):
         return {"failures": self._worker_failures[-req.get("limit", 1000):]}
 
+    # ------------------------------------------------------------- metrics
+
+    async def handle_ReportUserMetrics(self, req):
+        """Workers push ray_tpu.util.metrics records with their task-event
+        flush; series are keyed by (name, labels) — the reporter already
+        stamped worker/job labels so series never collide across workers."""
+        for rec in req.get("records", []):
+            key = (rec["name"], tuple(sorted(rec.get("labels", {}).items())))
+            cur = self.user_metrics.get(key)
+            if cur is None:
+                self.user_metrics[key] = cur = {
+                    "kind": rec["kind"], "name": rec["name"],
+                    "help": rec.get("help", ""), "labels": rec.get("labels", {}),
+                    "value": 0.0, "buckets": {}, "count": 0, "sum": 0.0,
+                    "boundaries": rec.get("boundaries") or [],
+                }
+            if rec["kind"] == "gauge":
+                cur["value"] = rec["value"]
+            elif rec["kind"] == "counter":
+                cur["value"] += rec["value"]
+            elif rec["kind"] == "histogram":
+                for b, c in rec.get("buckets", {}).items():
+                    cur["buckets"][b] = cur["buckets"].get(b, 0) + c
+                cur["count"] += rec.get("count", 0)
+                cur["sum"] += rec.get("sum", 0.0)
+        return {"ok": True}
+
+    def _collect_metrics(self) -> str:
+        from ray_tpu._private.metrics import render_prometheus
+
+        samples = []
+
+        def count_by_state(metric: str, rows):
+            by_state: Dict[str, int] = {}
+            for r in rows:
+                by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+            for state, count in by_state.items():
+                samples.append((metric, {"state": state}, count))
+
+        count_by_state("ray_tpu_gcs_nodes", self.nodes.values())
+        count_by_state("ray_tpu_gcs_actors", self.actors.values())
+        count_by_state("ray_tpu_gcs_placement_groups", self.placement_groups.values())
+        count_by_state("ray_tpu_gcs_jobs", self.jobs.values())
+        samples.append(("ray_tpu_gcs_task_events_buffered", {}, len(self.task_events)))
+        samples.append(("ray_tpu_gcs_uptime_seconds", {}, time.time() - self.start_time))
+        # user metrics (util/metrics.py)
+        for rec in self.user_metrics.values():
+            if rec["kind"] == "histogram":
+                cumulative = 0
+                for b in rec.get("boundaries", []):
+                    cumulative += rec["buckets"].get(str(b), 0)
+                    samples.append(
+                        (f"{rec['name']}_bucket", {**rec["labels"], "le": str(b)}, cumulative)
+                    )
+                # Prometheus requires le="+Inf" == count.
+                samples.append(
+                    (f"{rec['name']}_bucket", {**rec["labels"], "le": "+Inf"}, rec["count"])
+                )
+                samples.append((f"{rec['name']}_count", rec["labels"], rec["count"]))
+                samples.append((f"{rec['name']}_sum", rec["labels"], rec["sum"]))
+            else:
+                samples.append((rec["name"], rec["labels"], rec["value"]))
+        return render_prometheus(samples)
+
     async def handle_Ping(self, req):
-        return {"ok": True, "uptime": time.time() - self.start_time}
+        return {
+            "ok": True,
+            "uptime": time.time() - self.start_time,
+            "metrics_port": getattr(self, "metrics_port", 0),
+        }
 
 
 def main(argv=None):

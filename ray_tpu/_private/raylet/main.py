@@ -121,6 +121,15 @@ class NodeManager:
         # Warm the fork server immediately so the first lease forks in ~ms
         # (reference: worker_pool.h:359 PrestartWorkers).
         asyncio.ensure_future(self.worker_pool._ensure_fork_server())
+        try:
+            from ray_tpu._private.metrics import start_metrics_http_server
+
+            self._metrics_server, self.metrics_port = await start_metrics_http_server(
+                self.host, self._collect_metrics
+            )
+        except Exception:
+            logger.exception("metrics endpoint failed to start")
+            self.metrics_port = 0
         await self._register_node()
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reaper_loop()))
@@ -145,8 +154,51 @@ class NodeManager:
                 "resources": self.total.to_dict(),
                 "labels": self.labels,
                 "is_head": self.is_head,
+                "metrics_port": getattr(self, "metrics_port", 0),
             },
         )
+
+    def _collect_metrics(self) -> str:
+        """Prometheus samples for this node (reference: stats/metric_defs.cc
+        resource/object-store/scheduler gauges)."""
+        from ray_tpu._private.metrics import render_prometheus
+
+        node = self.node_id.hex()[:12]
+        samples = []
+        for k, v in self.total.to_dict().items():
+            samples.append(
+                ("ray_tpu_node_resource_total", {"node": node, "resource": k}, v)
+            )
+        for k, v in self.available.to_dict().items():
+            samples.append(
+                ("ray_tpu_node_resource_available", {"node": node, "resource": k}, v)
+            )
+        idle = self.worker_pool.num_idle()
+        total_workers = len(self.worker_pool.workers)
+        samples.append(("ray_tpu_node_workers", {"node": node, "state": "idle"}, idle))
+        samples.append(
+            ("ray_tpu_node_workers", {"node": node, "state": "leased"},
+             max(0, total_workers - idle))
+        )
+        samples.append(("ray_tpu_node_leases", {"node": node}, len(self.leases)))
+        samples.append(
+            ("ray_tpu_node_pg_bundles", {"node": node}, len(self.bundles))
+        )
+        try:
+            s = self.plasma.stats()
+            samples.append(("ray_tpu_object_store_used_bytes", {"node": node}, s["used_bytes"]))
+            samples.append(("ray_tpu_object_store_capacity_bytes", {"node": node}, s["capacity_bytes"]))
+            samples.append(("ray_tpu_object_store_num_objects", {"node": node}, s["num_objects"]))
+            samples.append(("ray_tpu_object_store_evicted_bytes", {"node": node}, s["evicted_bytes"]))
+        except Exception:
+            pass
+        samples.append(("ray_tpu_spilled_objects", {"node": node}, len(self._spilled)))
+        samples.append(
+            ("ray_tpu_spilled_bytes", {"node": node},
+             sum(size for _, size in self._spilled.values()))
+        )
+        samples.append(("ray_tpu_pulls_in_flight", {"node": node}, len(self._pulls)))
+        return render_prometheus(samples)
 
     async def _heartbeat_loop(self):
         period = RTPU_CONFIG.health_check_period_ms / 1000.0
@@ -773,19 +825,24 @@ class NodeManager:
         the driver's log stream)."""
         tracked: Dict[str, dict] = {}  # path -> {off,job,pid,err,last_growth}
 
-        async def _publish(t, lines):
-            await self.gcs.notify(
-                "Publish",
-                {
-                    "channel": f"logs:{t['job'].hex()}",
-                    "message": {
-                        "pid": t["pid"],
-                        "ip": self.host,
-                        "is_err": t["err"],
-                        "lines": lines,
+        async def _publish(t, lines) -> bool:
+            try:
+                await self.gcs.call(
+                    "Publish",
+                    {
+                        "channel": f"logs:{t['job'].hex()}",
+                        "message": {
+                            "pid": t["pid"],
+                            "ip": self.host,
+                            "is_err": t["err"],
+                            "lines": lines,
+                        },
                     },
-                },
-            )
+                    timeout=10,
+                )
+                return True
+            except Exception:
+                return False
 
         while True:
             await asyncio.sleep(0.25)
@@ -827,14 +884,16 @@ class NodeManager:
                             continue
                         cut = len(data) - 1
                     data = data[: cut + 1]
-                    t["off"] += len(data)
-                    t["last_growth"] = now
                     lines = [
                         ln.decode("utf-8", "replace")
                         for ln in data.splitlines()
                     ]
-                    if lines:
-                        await _publish(t, lines)
+                    # Advance the offset only after a successful publish so
+                    # lines produced around a GCS outage are retried, not
+                    # silently dropped.
+                    if not lines or await _publish(t, lines):
+                        t["off"] += len(data)
+                        t["last_growth"] = now
             except Exception:
                 logger.exception("log monitor error")
 

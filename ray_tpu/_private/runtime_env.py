@@ -89,7 +89,13 @@ def extract_working_dir(uri: str, blob: Optional[bytes], base_dir: str) -> str:
     os.makedirs(tmp, exist_ok=True)
     try:
         with zipfile.ZipFile(io.BytesIO(blob)) as zf:
-            zf.extractall(tmp)
+            for info in zf.infolist():
+                extracted = zf.extract(info, tmp)
+                # extractall/extract ignore permissions; restore the modes
+                # packaged in external_attr (executables must stay runnable).
+                mode = (info.external_attr >> 16) & 0xFFFF
+                if mode:
+                    os.chmod(extracted, mode & 0o7777)
         os.rename(tmp, target)
     except OSError:
         # Lost a concurrent-extract race: the winner's tree is equivalent.

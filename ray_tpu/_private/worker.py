@@ -280,6 +280,42 @@ class CoreWorker:
                     await self.gcs_aio.notify("AddTaskEvents", {"events": events})
                 except Exception:
                     pass
+            self._flush_user_metrics()
+
+    def _flush_user_metrics(self):
+        """Push ray_tpu.util.metrics records (if that module is in use) to
+        the GCS aggregator, stamped with worker/job labels so series from
+        different workers never collide."""
+        import sys as _sys
+
+        mod = _sys.modules.get("ray_tpu.util.metrics")
+        if mod is None:
+            return
+        try:
+            records = mod.drain_records()
+        except Exception:
+            return
+        if not records:
+            return
+        wid = self.worker_id.hex()[:12]
+        jid = self.job_id.hex()
+        for rec in records:
+            rec["labels"] = {**rec["labels"], "WorkerId": wid, "JobId": jid}
+
+        async def _push():
+            try:
+                await self.gcs_aio.call(
+                    "ReportUserMetrics", {"records": records}, timeout=10
+                )
+            except Exception:
+                # Re-merge the drained deltas: a GCS blip must not lose
+                # counter increments.
+                try:
+                    mod.restore_records(records)
+                except Exception:
+                    pass
+
+        asyncio.ensure_future(_push())
 
     # ------------------------------------------------ ObjectRef hooks (sync)
 

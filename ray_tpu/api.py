@@ -256,33 +256,10 @@ def available_resources() -> Dict[str, float]:
 
 
 def timeline(filename: Optional[str] = None):
-    """Chrome-tracing dump of task events (reference: _private/state.py:944)."""
-    worker = get_global_worker()
-    events = worker.gcs.call("GetTaskEvents", {})["events"]
-    trace = []
-    starts: Dict[str, dict] = {}
-    for ev in events:
-        key = ev["task_id"]
-        if ev["state"] == "RUNNING":
-            starts[key] = ev
-        elif ev["state"] in ("FINISHED", "FAILED") and key in starts:
-            s = starts.pop(key)
-            trace.append(
-                {
-                    "name": ev["name"],
-                    "cat": "task",
-                    "ph": "X",
-                    "ts": s["ts"] * 1e6,
-                    "dur": (ev["ts"] - s["ts"]) * 1e6,
-                    "pid": ev["node_id"][:8],
-                    "tid": ev["worker_id"][:8],
-                    "args": {"task_id": ev["task_id"], "state": ev["state"]},
-                }
-            )
-    if filename:
-        import json
+    """Chrome-tracing dump of task events (reference: _private/state.py:944
+    chrome_tracing_dump; open in chrome://tracing or ui.perfetto.dev)."""
+    from ray_tpu._private.timeline import timeline as _timeline
 
-        with open(filename, "w") as f:
-            json.dump(trace, f)
-        return filename
-    return trace
+    get_global_worker()  # raise early if not initialized
+    result = _timeline(filename)
+    return filename if filename else result

@@ -40,6 +40,7 @@ def list_nodes(address: Optional[str] = None, *, filters=None, limit: int = 10_0
             "state": n["state"],
             "node_ip": n["ip"],
             "raylet_port": n["raylet_port"],
+            "metrics_port": n.get("metrics_port", 0),
             "is_head_node": bool(n.get("is_head")),
             "resources_total": n.get("resources_total", {}),
             "resources_available": n.get("resources_available", {}),

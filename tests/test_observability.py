@@ -1,0 +1,157 @@
+"""State API, task timeline, Prometheus metrics, user metrics.
+
+Reference contracts: ray.util.state list_* (util/state/api.py),
+`ray timeline` Chrome-trace dump (_private/state.py:944), Prometheus
+endpoints fed by the stats pipeline (stats/metric_defs.cc,
+_private/metrics_agent.py), user metrics (util/metrics.py:19).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+
+def _fetch(port: str | int) -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_state_api_lists_cluster_entities(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "pong"
+
+    h = Holder.options(name="held").remote()
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    assert ray_tpu.get([work.remote(i) for i in range(3)]) == [1, 2, 3]
+    big_ref = ray_tpu.put(b"x" * (1024 * 1024))
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["is_head_node"]
+
+    actors = state.list_actors()
+    assert any(a["name"] == "held" and a["state"] == "ALIVE" for a in actors)
+    assert state.list_actors(filters=[("state", "=", "DEAD")]) == []
+
+    jobs = state.list_jobs()
+    assert len(jobs) == 1 and jobs[0]["status"] == "RUNNING"
+
+    # Task events flush on a 1s cadence; poll for them.
+    deadline = time.time() + 15
+    tasks = []
+    while time.time() < deadline:
+        tasks = state.list_tasks()
+        if sum(1 for t in tasks if t["state"] == "FINISHED") >= 3:
+            break
+        time.sleep(0.3)
+    finished = [t for t in tasks if t["state"] == "FINISHED"]
+    assert len(finished) >= 3
+    assert any("work" in t["name"] for t in finished)
+
+    summary = state.summarize_tasks()
+    assert summary["total_tasks"] >= 3
+    assert any("work" in name for name in summary["summary"])
+
+    objs = state.list_objects()
+    assert any(
+        o["object_id"] == big_ref.object_id().hex() and o["pinned"] for o in objs
+    )
+
+    workers = state.list_workers()
+    assert any(w["is_alive"] for w in workers)  # live actor/task workers
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def step():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([step.remote() for _ in range(4)])
+    out = tmp_path / "trace.json"
+    deadline = time.time() + 15
+    events = []
+    while time.time() < deadline:
+        ray_tpu.timeline(str(out))
+        events = json.loads(out.read_text())
+        if sum(1 for e in events if e.get("ph") == "X") >= 4:
+            break
+        time.sleep(0.3)
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert len(complete) >= 4
+    for e in complete:
+        # Chrome trace-event required fields; durations in microseconds.
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] >= 0.05 * 1e6 * 0.5
+
+
+def test_prometheus_endpoints(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    ping = w.gcs.ping()
+    assert ping["metrics_port"]
+    gcs_text = _fetch(ping["metrics_port"])
+    assert 'ray_tpu_gcs_nodes{state="ALIVE"} 1' in gcs_text
+    assert "ray_tpu_gcs_uptime_seconds" in gcs_text
+
+    node = w.gcs.get_all_node_info()[0]
+    assert node["metrics_port"]
+    raylet_text = _fetch(node["metrics_port"])
+    assert "ray_tpu_node_resource_total" in raylet_text
+    assert "ray_tpu_object_store_capacity_bytes" in raylet_text
+
+
+def test_user_metrics_export(ray_start_regular):
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    @ray_tpu.remote
+    def instrumented():
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        c = Counter("app_requests_total", "requests", tag_keys=("route",))
+        c.inc(3, tags={"route": "/infer"})
+        Gauge("app_queue_depth", "queue").set(7)
+        h = Histogram("app_latency_s", "latency", boundaries=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        return 1
+
+    assert ray_tpu.get(instrumented.remote()) == 1
+    port = worker_mod.global_worker.gcs.ping()["metrics_port"]
+    deadline = time.time() + 20  # flushed on the 1s task-event cadence
+    text = ""
+    while time.time() < deadline:
+        text = _fetch(port)
+        if "app_requests_total" in text:
+            break
+        time.sleep(0.5)
+    assert 'route="/infer"' in text
+    assert "app_queue_depth" in text
+    assert "app_latency_s_count" in text and "app_latency_s_bucket" in text
+
+
+def test_metric_validation():
+    from ray_tpu.util.metrics import Counter, Histogram
+
+    with pytest.raises(ValueError):
+        Counter("c").inc(-1)
+    with pytest.raises(ValueError):
+        Histogram("h")  # boundaries required
+    with pytest.raises(ValueError):
+        Counter("c2", tag_keys=("a",)).inc(1, tags={"b": "x"})
