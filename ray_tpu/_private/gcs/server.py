@@ -400,13 +400,31 @@ class GcsServer:
         await self._mark_node_dead(req["node_id"], "unregistered")
         return {"ok": True}
 
+    def _autoscaler_active_now(self) -> bool:
+        """True while an autoscaler heartbeat (timestamped KV) is fresh — a
+        crashed autoscaler must not leave raylets queueing infeasible work
+        forever."""
+        v = self.kv.get("", b"__autoscaler_active__")
+        if not v:
+            return False
+        try:
+            return time.time() - float(v) < 30.0
+        except (TypeError, ValueError):
+            return True  # legacy non-timestamped value
+
+    async def handle_GetAutoscalerActive(self, req):
+        return {"active": self._autoscaler_active_now()}
+
     async def handle_Heartbeat(self, req):
         node_id = req["node_id"]
         self.node_last_beat[node_id] = time.time()
         # "known" lets a raylet detect a GCS that restarted without its
         # registration (e.g. persistence disabled) and re-register.
         info = self.nodes.get(node_id)
-        return {"known": info is not None and info["state"] == "ALIVE"}
+        return {
+            "known": info is not None and info["state"] == "ALIVE",
+            "autoscaler_active": self._autoscaler_active_now(),
+        }
 
     async def handle_ReportResources(self, req):
         node = self.nodes.get(req["node_id"])
@@ -414,6 +432,9 @@ class GcsServer:
             return
         node["resources_available"] = req["available"]
         node["resources_total"] = req["total"]
+        node["pending_demands"] = req.get("pending_demands", [])
+        node["num_leases"] = req.get("num_leases", 0)
+        node["num_workers"] = req.get("num_workers", 0)
         self.node_last_beat[req["node_id"]] = time.time()
         if self.pending_actor_queue:
             asyncio.ensure_future(self._schedule_pending_actors())
@@ -436,6 +457,47 @@ class GcsServer:
 
     async def handle_GetInternalConfig(self, req):
         return {"config": RTPU_CONFIG.dump(), "session_dir": self.session_dir}
+
+    async def handle_GetClusterLoad(self, req):
+        """Autoscaler input: everything waiting for resources right now
+        (reference: GcsAutoscalerStateManager::HandleGetClusterResourceState,
+        gcs_autoscaler_state_manager.h:30 — pending task shapes, pending
+        actors, unplaced placement-group bundles, per-node utilization)."""
+        pending_tasks: List[dict] = []
+        for nid in self.alive_nodes():
+            pending_tasks.extend(self.nodes[nid].get("pending_demands", []))
+        pending_actors = []
+        for actor_id in self.pending_actor_queue:
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec["state"] in (PENDING_CREATION, RESTARTING):
+                pending_actors.append(dict(rec["creation_spec"].get("resources", {})))
+        pending_pg_bundles = []
+        for pg_id in self.pending_pg_queue:
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg["state"] in ("PENDING", "RESCHEDULING"):
+                for b in pg["bundles"]:
+                    if b.get("node_id") is None:
+                        pending_pg_bundles.append(
+                            {"resources": dict(b["resources"]), "strategy": pg["strategy"]}
+                        )
+        nodes = [
+            {
+                "node_id": nid,
+                "resources_total": self.nodes[nid]["resources_total"],
+                "resources_available": self.nodes[nid]["resources_available"],
+                "num_leases": self.nodes[nid].get("num_leases", 0),
+                "num_workers": self.nodes[nid].get("num_workers", 0),
+                "labels": self.nodes[nid].get("labels", {}),
+                "is_head": self.nodes[nid].get("is_head", False),
+            }
+            for nid in self.alive_nodes()
+        ]
+        return {
+            "pending_tasks": pending_tasks,
+            "pending_actors": pending_actors,
+            "pending_pg_bundles": pending_pg_bundles,
+            "nodes": nodes,
+        }
 
     # --------------------------------------------------------------------- kv
 

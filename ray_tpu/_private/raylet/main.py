@@ -88,6 +88,7 @@ class NodeManager:
         self._actor_workers: Dict[bytes, bytes] = {}
         # cluster view: node_id -> info (from GCS)
         self.cluster_view: Dict[bytes, dict] = {}
+        self._autoscaler_active = False
         # object pulls in flight: object_id bytes -> asyncio.Event
         self._pulls: Dict[bytes, asyncio.Event] = {}
         # pinned primary copies: object_id bytes -> memoryview
@@ -204,25 +205,40 @@ class NodeManager:
         period = RTPU_CONFIG.health_check_period_ms / 1000.0
         report_period = RTPU_CONFIG.resource_report_period_ms / 1000.0
         last_report = 0.0
+        last_pending: List[dict] = []
         while True:
             try:
                 beat = await self.gcs.call(
                     "Heartbeat", {"node_id": self.node_id.binary()}, timeout=10
                 )
-                if beat is not None and not beat.get("known", True):
-                    # The GCS restarted without our registration (persistence
-                    # off or state lost): re-register so the cluster resumes.
-                    logger.warning("GCS lost our registration; re-registering")
-                    await self._register_node()
-                    self._resources_dirty = True
+                if beat is not None:
+                    self._autoscaler_active = beat.get("autoscaler_active", False)
+                    if not beat.get("known", True):
+                        # The GCS restarted without our registration
+                        # (persistence off or state lost): re-register so
+                        # the cluster resumes.
+                        logger.warning("GCS lost our registration; re-registering")
+                        await self._register_node()
+                        self._resources_dirty = True
                 now = time.time()
-                if self._resources_dirty or now - last_report > report_period * 4:
+                pending = [dict(w["resources"]) for w in self._lease_waiters
+                           if "resources" in w]
+                if (
+                    self._resources_dirty
+                    or pending != last_pending  # incl. drain-to-empty: a
+                    # stale pending report makes the autoscaler double-launch
+                    or now - last_report > report_period * 4
+                ):
+                    last_pending = pending
                     await self.gcs.notify(
                         "ReportResources",
                         {
                             "node_id": self.node_id.binary(),
                             "available": self.available.to_dict(),
                             "total": self.total.to_dict(),
+                            "pending_demands": pending,
+                            "num_leases": len(self.leases),
+                            "num_workers": len(self.worker_pool.workers),
                         },
                     )
                     self._resources_dirty = False
@@ -235,7 +251,14 @@ class NodeManager:
         while True:
             try:
                 nodes = await self.gcs.get_all_node_info()
-                self.cluster_view = {n["node_id"]: n for n in nodes if n["state"] == "ALIVE"}
+                new_view = {n["node_id"]: n for n in nodes if n["state"] == "ALIVE"}
+                grew = set(new_view) - set(self.cluster_view)
+                self.cluster_view = new_view
+                if grew:
+                    # New capacity (e.g. autoscaler launch): re-evaluate
+                    # queued lease requests so they can spill to it.
+                    self._kick_waiters()
+                # autoscaler-active state rides on the Heartbeat replies.
             except Exception:
                 pass
             await asyncio.sleep(0.5)
@@ -436,15 +459,37 @@ class NodeManager:
                     if spill_any is not None:
                         return {"spill": {"ip": spill_any["ip"], "port": spill_any["raylet_port"],
                                            "node_id": spill_any["node_id"]}}
-                    return {"error": f"infeasible resource request {resources}"}
+                    if not self._autoscaler_active:
+                        # Authoritative check (the heartbeat may not have
+                        # seen a just-started autoscaler): only on this
+                        # rare infeasible path.
+                        try:
+                            r = await self.gcs.call(
+                                "GetAutoscalerActive", {}, timeout=5
+                            )
+                            self._autoscaler_active = bool(r.get("active"))
+                        except Exception:
+                            pass
+                    if not self._autoscaler_active:
+                        return {"error": f"infeasible resource request {resources}"}
+                    # else: queue below — the recorded demand will drive an
+                    # autoscaler launch, and the new node kicks the waiter.
                 if spill_now is not None:
                     return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
                                        "node_id": spill_now["node_id"]}}
-            # queue locally until resources free up
+            # queue locally until resources free up; the recorded shape
+            # feeds the GCS load report that drives the autoscaler
+            # (reference: gcs_autoscaler_state_manager.h cluster load).
+            # PG-bound tasks are excluded: their bundle is already placed,
+            # so a new node could never serve them — reporting them would
+            # trigger pointless slice launches.
             waiter = {"event": asyncio.Event()}
+            if not is_pg:
+                waiter["resources"] = dict(resources)
             self._lease_waiters.append(waiter)
             timeout = deadline - time.time()
             if timeout <= 0:
+                self._lease_waiters.remove(waiter)
                 return {"retry": True}
             try:
                 await asyncio.wait_for(waiter["event"].wait(), timeout)
