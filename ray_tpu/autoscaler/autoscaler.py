@@ -139,7 +139,10 @@ class Autoscaler:
                 try:
                     self.provider.terminate_node(pid)
                 except Exception:
+                    # Keep it in the counts: max_workers must still see it,
+                    # or repeated failed terminations over-launch unboundedly.
                     logger.exception("termination of %s failed", pid)
+                    continue
                 provider_nodes.pop(pid, None)
                 counts_by_type[st["type"]] -= 1
 
@@ -197,7 +200,11 @@ class Autoscaler:
         for pid, node_type in provider_nodes.items():
             st = {
                 "type": node_type,
-                "age": now - self._launched_at.get(pid, now),
+                # setdefault: a node first seen NOW (autoscaler restart,
+                # pre-existing provider nodes) starts aging from discovery —
+                # a .get(pid, now) default would pin its age at 0 forever,
+                # making a dead node permanent phantom capacity.
+                "age": now - self._launched_at.setdefault(pid, now),
                 "registered": False,
                 "row": None,
             }

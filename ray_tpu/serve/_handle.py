@@ -1,0 +1,143 @@
+"""DeploymentHandle: the client side of a deployment.
+
+Counterpart of the reference's handle → router → replica-scheduler chain
+(reference: python/ray/serve/handle.py:714 DeploymentHandle,
+_private/router.py:320, _private/replica_scheduler/pow_2_scheduler.py:49
+PowerOfTwoChoicesReplicaScheduler). Replica sets are fetched from the
+controller and cached briefly; each call picks the less-loaded of two
+random replicas using handle-local in-flight counts (the reference's
+client-side queue-length view).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_REPLICA_CACHE_TTL_S = 1.0
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef
+    (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []
+        self._fetched_at = 0.0
+        self._inflight: Dict[int, int] = {}  # replica index -> in-flight
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self._method))
+
+    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, method_name)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name)
+
+    def _refresh_replicas(self, force: bool = False):
+        now = time.time()
+        with self._lock:
+            if not force and self._replicas and now - self._fetched_at < _REPLICA_CACHE_TTL_S:
+                return
+        import ray_tpu
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        names = ray_tpu.get(
+            controller.get_replica_names.remote(self.deployment_name), timeout=30
+        )
+        replicas = []
+        for n in names:
+            try:
+                replicas.append(ray_tpu.get_actor(n))
+            except Exception:
+                pass
+        with self._lock:
+            self._replicas = replicas
+            self._fetched_at = now
+            self._inflight = {i: 0 for i in range(len(replicas))}
+
+    def _pick(self) -> tuple:
+        """Power-of-two-choices on handle-local in-flight counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"no replicas for deployment '{self.deployment_name}'"
+                )
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx, self._replicas[idx]
+
+    def _done(self, idx: int):
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        deadline = time.time() + 60
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                self._refresh_replicas()
+                idx, replica = self._pick()
+            except Exception as e:
+                last_err = e
+                time.sleep(0.25)
+                continue
+            try:
+                ref = replica.handle_request.remote(
+                    self._method, args, kwargs
+                )
+                # decrement when the call resolves (best effort, piggybacks
+                # on the ref's completion via a daemon thread-free path: the
+                # response object decrements on result()).
+                resp = DeploymentResponse(ref)
+                _attach_done(resp, self, idx)
+                return resp
+            except Exception as e:
+                last_err = e
+                self._refresh_replicas(force=True)
+        raise RuntimeError(
+            f"could not reach any replica of '{self.deployment_name}': {last_err}"
+        )
+
+
+def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int):
+    original = resp.result
+    done = {"fired": False}
+
+    def result(timeout: Optional[float] = None):
+        try:
+            return original(timeout)
+        finally:
+            if not done["fired"]:
+                done["fired"] = True
+                handle._done(idx)
+
+    resp.result = result

@@ -1,0 +1,112 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Counterpart of the reference's ReplicaActor
+(reference: python/ray/serve/_private/replica.py:231 — wraps the user
+callable, enforces max_ongoing_requests, exposes queue length for the
+router and health checks for the controller).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+
+class Replica:
+    """Instantiated inside a dedicated (async, max_concurrency) actor."""
+
+    def __init__(self, serialized: dict, init_args: tuple, init_kwargs: dict):
+        import cloudpickle
+
+        from ray_tpu.serve._deployment import _HandleRef
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        func_or_class = cloudpickle.loads(serialized["callable"])
+        self._name = serialized["name"]
+        init_args = tuple(
+            DeploymentHandle(a.deployment_name) if isinstance(a, _HandleRef) else a
+            for a in init_args
+        )
+        init_kwargs = {
+            k: DeploymentHandle(v.deployment_name) if isinstance(v, _HandleRef) else v
+            for k, v in init_kwargs.items()
+        }
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        self._ongoing = 0
+        self._handled = 0
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        import asyncio
+        import functools
+
+        self._ongoing += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method or "__call__")
+            if inspect.iscoroutinefunction(target) or getattr(
+                target, "_is_serve_batch", False
+            ):
+                return await target(*args, **kwargs)
+            # Sync callables run in the thread pool so max_ongoing_requests
+            # gives real concurrency and metadata/health stay responsive
+            # (reference: replica.py runs sync user methods off-loop).
+            loop = asyncio.get_running_loop()
+            result = await loop.run_in_executor(
+                None, functools.partial(target, *args, **kwargs)
+            )
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+            self._handled += 1
+
+    def get_metadata(self) -> dict:
+        return {"ongoing": self._ongoing, "handled": self._handled}
+
+    async def start_metrics_push(self, replica_name: str):
+        """Controller calls this once after creation: push ongoing-request
+        stats every 0.5s (reference: replicas push autoscaling metrics to
+        the controller, serve/_private/autoscaling_state.py — a pull would
+        queue FIFO behind user requests and always observe a drained
+        queue)."""
+        import asyncio
+
+        if getattr(self, "_push_task", None) is not None:
+            return
+        self._replica_name = replica_name
+
+        async def _loop():
+            import ray_tpu
+            from ray_tpu.serve._handle import CONTROLLER_NAME
+
+            controller = None
+            while True:
+                try:
+                    if controller is None:
+                        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    controller.report_replica_metrics.remote(
+                        self._name,
+                        replica_name,
+                        {"ongoing": self._ongoing, "handled": self._handled},
+                    )
+                except Exception:
+                    controller = None
+                await asyncio.sleep(0.5)
+
+        self._push_task = asyncio.ensure_future(_loop())
+
+    async def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            result = user_check()
+            if inspect.iscoroutine(result):
+                await result
+        return True
