@@ -1,0 +1,228 @@
+"""Dashboard head: JSON/REST API over cluster state + job submission.
+
+Counterpart of the reference's dashboard head server
+(reference: python/ray/dashboard/head.py:79 — aiohttp app aggregating
+state + the job module's REST endpoints
+dashboard/modules/job/job_head.py). Dependency-free asyncio HTTP/1.1 here;
+the React client is out of scope, but a plain HTML summary is served at /
+so the endpoint is human-checkable.
+
+Routes:
+  GET  /api/cluster                cluster resource summary
+  GET  /api/nodes|actors|tasks|objects|workers|placement_groups|jobs
+  GET  /api/jobs/                  submitted jobs (job_submission API)
+  POST /api/jobs/                  submit {entrypoint, runtime_env?, ...}
+  GET  /api/jobs/<id>              job info
+  GET  /api/jobs/<id>/logs         {"logs": "..."}
+  POST /api/jobs/<id>/stop         {"stopped": bool}
+  GET  /api/version
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.dashboard")
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port = 0
+
+    # --------------------------------------------------------- data access
+
+    def _state(self):
+        from ray_tpu.util import state
+
+        return state
+
+    def _job_manager(self):
+        from ray_tpu._private.gcs.client import GcsClient
+        from ray_tpu.job_submission import JobManager
+
+        return JobManager(GcsClient.from_address(self.gcs_address))
+
+    def _collect(self, path: str, method: str, body: Optional[dict]):
+        """Blocking handler (run in executor): returns (status, payload)."""
+        state = self._state()
+        addr = self.gcs_address
+        if path == "/api/cluster":
+            from ray_tpu._private.gcs.client import GcsClient
+
+            gcs = GcsClient.from_address(addr)
+            return 200, {
+                "cluster": gcs.get_cluster_resources(),
+                "nodes": len(state.list_nodes(addr)),
+            }
+        if path == "/api/nodes":
+            return 200, {"nodes": state.list_nodes(addr)}
+        if path == "/api/actors":
+            return 200, {"actors": state.list_actors(addr)}
+        if path == "/api/tasks":
+            return 200, {"tasks": state.list_tasks(addr)}
+        if path == "/api/objects":
+            return 200, {"objects": state.list_objects(addr)}
+        if path == "/api/workers":
+            return 200, {"workers": state.list_workers(addr)}
+        if path == "/api/placement_groups":
+            return 200, {"placement_groups": state.list_placement_groups(addr)}
+        if path == "/api/version":
+            from ray_tpu._version import version
+
+            return 200, {"version": version}
+        if path.startswith("/api/jobs"):
+            return self._jobs_api(path, method, body)
+        if path == "/" or path == "/index.html":
+            return 200, None  # HTML handled by caller
+        return 404, {"error": f"no route {path}"}
+
+    def _jobs_api(self, path: str, method: str, body: Optional[dict]):
+        mgr = self._job_manager()
+        parts = [p for p in path.split("/") if p]  # ["api","jobs",...]
+        if len(parts) == 2:
+            if method == "POST":
+                body = body or {}
+                if not body.get("entrypoint"):
+                    return 400, {"error": "entrypoint is required"}
+                sid = mgr.submit_job(
+                    entrypoint=body["entrypoint"],
+                    submission_id=body.get("submission_id"),
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                )
+                return 200, {"submission_id": sid}
+            return 200, {"jobs": mgr.list_jobs()}
+        sid = parts[2]
+        try:
+            if len(parts) == 3 and method == "GET":
+                return 200, mgr.get_job_info(sid)
+            if len(parts) == 4 and parts[3] == "logs":
+                return 200, {"logs": mgr.get_job_logs(sid)}
+            if len(parts) == 4 and parts[3] == "stop" and method == "POST":
+                return 200, {"stopped": mgr.stop_job(sid)}
+        except ValueError as e:
+            return 404, {"error": str(e)}
+        return 404, {"error": f"no route {path}"}
+
+    def _index_html(self) -> bytes:
+        state = self._state()
+        nodes = state.list_nodes(self.gcs_address)
+        actors = state.list_actors(self.gcs_address)
+        rows = "".join(
+            f"<tr><td>{n['node_id'][:12]}</td><td>{n['state']}</td>"
+            f"<td>{n['node_ip']}</td><td>{n['resources_total']}</td></tr>"
+            for n in nodes
+        )
+        return (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            f"<h2>ray_tpu cluster @ {self.gcs_address}</h2>"
+            f"<p>{len(nodes)} nodes, {len(actors)} actors. "
+            "JSON API under <code>/api/*</code>.</p>"
+            "<table border=1 cellpadding=4><tr><th>node</th><th>state</th>"
+            f"<th>ip</th><th>resources</th></tr>{rows}</table>"
+            "</body></html>"
+        ).encode()
+
+    # ---------------------------------------------------------------- http
+
+    async def _handle(self, reader, writer):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1].split("?")[0]
+            headers = {}
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = None
+            length = int(headers.get("content-length", 0) or 0)
+            if length:
+                raw = await reader.readexactly(length)
+                try:
+                    body = json.loads(raw)
+                except Exception:
+                    body = None
+            loop = asyncio.get_running_loop()
+            try:
+                status, payload = await loop.run_in_executor(
+                    None, self._collect, path, method, body
+                )
+            except Exception as e:
+                logger.exception("dashboard handler failed")
+                status, payload = 500, {"error": str(e)}
+            if payload is None and status == 200:
+                out = await loop.run_in_executor(None, self._index_html)
+                ctype = "text/html; charset=utf-8"
+            else:
+                out = json.dumps(payload, default=str).encode()
+                ctype = "application/json"
+            reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                      500: "Internal Server Error"}.get(status, "OK")
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(out)}\r\nConnection: close\r\n\r\n".encode()
+                + out
+            )
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, "0.0.0.0", port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("dashboard on http://127.0.0.1:%d", self.port)
+        return self.port
+
+
+def start_dashboard(gcs_address: str, port: int = 0) -> Tuple[DashboardHead, int]:
+    """Start a dashboard in this process (on the shared IO thread)."""
+    from ray_tpu._private.rpc import IoThread
+
+    head = DashboardHead(gcs_address)
+    actual = IoThread.current().run(head.start(port))
+    return head, actual
+
+
+def main(argv=None):
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--port", type=int, default=8265)
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    async def run():
+        head = DashboardHead(args.gcs_address)
+        port = await head.start(args.port)
+        if args.port_file:
+            import os
+
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            os.replace(tmp, args.port_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

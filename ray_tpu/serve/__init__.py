@@ -89,17 +89,28 @@ def run(
         raise TypeError("serve.run expects a bound Application (use .bind())")
     controller = _get_or_create_controller()
     specs = []
+    from ray_tpu.serve._deployment import _HandleRef
+
+    def scope(v):
+        # Deployments are app-scoped (reference namespaces deployment names
+        # per application): two apps may both have a 'Model' without
+        # clobbering each other.
+        if isinstance(v, _HandleRef):
+            return _HandleRef(f"{name}#{v.deployment_name}")
+        return v
+
     for dep, init_args, init_kwargs in app.flatten():
         specs.append(
             {
-                "name": dep.name,
+                "name": f"{name}#{dep.name}",
                 "callable": cloudpickle.dumps(dep.func_or_class),
-                "init_args": init_args,
-                "init_kwargs": init_kwargs,
+                "init_args": tuple(scope(a) for a in init_args),
+                "init_kwargs": {k: scope(v) for k, v in init_kwargs.items()},
                 "num_replicas": dep.num_replicas,
                 "max_ongoing_requests": dep.max_ongoing_requests,
                 "ray_actor_options": dep.ray_actor_options,
                 "autoscaling_config": dep.autoscaling_config,
+                "health_check_period_s": dep.health_check_period_s,
             }
         )
     ingress = ray_tpu.get(
@@ -146,8 +157,10 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     return DeploymentHandle(info["ingress"])
 
 
-def get_deployment_handle(deployment_name: str) -> DeploymentHandle:
-    return DeploymentHandle(deployment_name)
+def get_deployment_handle(
+    deployment_name: str, app_name: str = "default"
+) -> DeploymentHandle:
+    return DeploymentHandle(f"{app_name}#{deployment_name}")
 
 
 def status() -> dict:

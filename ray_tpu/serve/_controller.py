@@ -26,6 +26,7 @@ class ServeController:
         self._apps: Dict[str, dict] = {}
         self._proxy = None
         self._proxy_port = 0
+        self._proxy_lock = None  # created lazily on the actor loop
         self._loop_task = None
         # replica name -> (last push ts, meta) — pushed by the replicas
         self._metrics: Dict[str, tuple] = {}
@@ -64,12 +65,24 @@ class ServeController:
                            spec.get("ray_actor_options"),
                            spec.get("max_ongoing_requests"))).encode())
             spec["version"] = h.hexdigest()
+            # Idempotent redeploy of an unchanged autoscaled version keeps
+            # the scaled-up target: resetting to min would kill loaded
+            # replicas and force a re-climb.
+            if (
+                st is not None
+                and spec.get("autoscaling_config")
+                and st["spec"].get("version") == spec["version"]
+            ):
+                cfg = spec["autoscaling_config"]
+                target = min(
+                    max(st["target"], cfg.get("min_replicas", 1)),
+                    cfg.get("max_replicas", 4),
+                )
             self._deployments[dep_name] = {
                 "spec": spec,
                 "target": target,
                 "replicas": (st or {}).get("replicas", {}),  # name -> rec
                 "next_id": (st or {}).get("next_id", 0),
-                "last_scale": 0.0,
                 "overload_since": None,
                 "underload_since": None,
             }
@@ -126,20 +139,26 @@ class ServeController:
         return self._proxy_port
 
     async def ensure_proxy(self, port: int = 0) -> int:
-        if self._proxy is not None:
-            return self._proxy_port
-        import ray_tpu
-        from ray_tpu.serve._proxy import ProxyActor
+        # Serialize concurrent callers: the second must await the first's
+        # startup, not read a not-yet-assigned port 0.
+        if self._proxy_lock is None:
+            self._proxy_lock = asyncio.Lock()
+        async with self._proxy_lock:
+            if self._proxy is not None:
+                return self._proxy_port
+            import ray_tpu
+            from ray_tpu.serve._proxy import ProxyActor
 
-        self._proxy = (
-            ray_tpu.remote(ProxyActor)
-            .options(name="SERVE_PROXY", max_concurrency=64, num_cpus=0)
-            .remote()
-        )
-        self._proxy_port = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: ray_tpu.get(self._proxy.start.remote(port), timeout=60)
-        )
-        return self._proxy_port
+            proxy = (
+                ray_tpu.remote(ProxyActor)
+                .options(name="SERVE_PROXY", max_concurrency=64, num_cpus=0)
+                .remote()
+            )
+            self._proxy_port = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: ray_tpu.get(proxy.start.remote(port), timeout=60)
+            )
+            self._proxy = proxy
+            return self._proxy_port
 
     # ------------------------------------------------------------ reconcile
 
@@ -179,6 +198,7 @@ class ServeController:
                 stale = (
                     (pushed is None and now - rec["created"] > 20.0)
                     or (pushed is not None and now - pushed[0] > 6.0)
+                    or (pushed is not None and not pushed[1].get("healthy", True))
                 )
                 if stale and pushed is None and self._actor_pending(rname):
                     # Still waiting for resources (e.g. the cluster
@@ -212,7 +232,9 @@ class ServeController:
                         spec.get("init_kwargs", {}),
                     )
                 )
-                handle.start_metrics_push.remote(rname)
+                handle.start_metrics_push.remote(
+                    rname, spec.get("health_check_period_s", 2.0)
+                )
                 st["replicas"][rname] = {
                     "handle": handle,
                     "created": now,

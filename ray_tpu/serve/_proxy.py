@@ -22,11 +22,17 @@ class ProxyActor:
     _ROUTE_TTL_S = 1.0
 
     def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
         self._server: Optional[asyncio.AbstractServer] = None
         self._port = 0
         self._routes: dict = {}  # app name -> info
         self._routes_at = 0.0
         self._handles: dict = {}  # ingress name -> DeploymentHandle
+        # Dedicated pool: the default loop executor caps at ~min(32, cpus+4)
+        # threads, which would head-of-line-block cheap requests (and route
+        # refreshes) behind slow ones.
+        self._pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="proxy")
 
     async def start(self, port: int = 0) -> int:
         self._server = await asyncio.start_server(self._handle, "127.0.0.1", port)
@@ -50,7 +56,8 @@ class ProxyActor:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
             loop = asyncio.get_running_loop()
             self._routes = await loop.run_in_executor(
-                None, lambda: ray_tpu.get(controller.list_apps.remote(), timeout=10)
+                self._pool,
+                lambda: ray_tpu.get(controller.list_apps.remote(), timeout=10),
             )
             self._routes_at = now
         best: Tuple[int, Optional[str]] = (-1, None)
@@ -109,7 +116,7 @@ class ProxyActor:
                 return resp.result(timeout=60)
 
             try:
-                result = await loop.run_in_executor(None, _call)
+                result = await loop.run_in_executor(self._pool, _call)
             except Exception as e:
                 await self._respond(
                     writer, 500, json.dumps({"error": str(e)}).encode()

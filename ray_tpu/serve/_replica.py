@@ -71,13 +71,18 @@ class Replica:
     def get_metadata(self) -> dict:
         return {"ongoing": self._ongoing, "handled": self._handled}
 
-    async def start_metrics_push(self, replica_name: str):
+    async def start_metrics_push(
+        self, replica_name: str, health_check_period_s: float = 2.0
+    ):
         """Controller calls this once after creation: push ongoing-request
         stats every 0.5s (reference: replicas push autoscaling metrics to
         the controller, serve/_private/autoscaling_state.py — a pull would
         queue FIFO behind user requests and always observe a drained
-        queue)."""
+        queue). The user's check_health() runs on its own period and rides
+        the same push: a failing check marks the replica unhealthy and the
+        controller replaces it."""
         import asyncio
+        import time as _time
 
         if getattr(self, "_push_task", None) is not None:
             return
@@ -88,14 +93,28 @@ class Replica:
             from ray_tpu.serve._handle import CONTROLLER_NAME
 
             controller = None
+            healthy = True
+            last_health_check = 0.0
             while True:
+                now = _time.time()
+                if now - last_health_check >= health_check_period_s:
+                    last_health_check = now
+                    try:
+                        await self.check_health()
+                        healthy = True
+                    except Exception:
+                        healthy = False
                 try:
                     if controller is None:
                         controller = ray_tpu.get_actor(CONTROLLER_NAME)
                     controller.report_replica_metrics.remote(
                         self._name,
                         replica_name,
-                        {"ongoing": self._ongoing, "handled": self._handled},
+                        {
+                            "ongoing": self._ongoing,
+                            "handled": self._handled,
+                            "healthy": healthy,
+                        },
                     )
                 except Exception:
                     controller = None

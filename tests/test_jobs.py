@@ -1,0 +1,155 @@
+"""Job submission (manager/SDK/REST), dashboard API, operator CLI.
+
+Reference contracts: JobManager + JobSupervisor run entrypoints as
+subprocesses with cluster address injected and status/logs queryable
+(dashboard/modules/job/job_manager.py:57, job_supervisor.py:51,
+sdk.py:35); the dashboard serves the state + job REST API
+(dashboard/head.py:79); the CLI mirrors `ray status/timeline/job ...`
+(scripts/scripts.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def job_cluster():
+    import ray_tpu
+    from ray_tpu import api
+
+    ray_tpu.init(num_cpus=4)
+    yield api._local_node.gcs_address
+    ray_tpu.shutdown()
+
+
+def test_job_lifecycle(job_cluster, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init(address='auto')\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('JOB_RESULT', sum(ray_tpu.get([f.remote(i) for i in range(5)])))\n"
+    )
+    client = JobSubmissionClient()
+    sid = client.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert sid.startswith("raysubmit_")
+
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        status = client.get_job_status(sid)
+        if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
+            break
+        time.sleep(0.5)
+    assert status == JobStatus.SUCCEEDED, client.get_job_logs(sid)
+    assert "JOB_RESULT 20" in client.get_job_logs(sid)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == sid for j in jobs)
+
+
+def test_job_failure_and_stop(job_cluster, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(bad) == JobStatus.FAILED:
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(bad) == JobStatus.FAILED
+    assert "code 3" in client.get_job_info(bad)["message"]
+
+    sleeper = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(300)'"
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(sleeper) == JobStatus.RUNNING:
+            break
+        time.sleep(0.3)
+    assert client.stop_job(sleeper)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(sleeper) == JobStatus.STOPPED:
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(sleeper) == JobStatus.STOPPED
+
+
+def test_dashboard_api_and_rest_jobs(job_cluster, tmp_path):
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    _, port = start_dashboard(job_cluster)
+    base = f"http://127.0.0.1:{port}"
+
+    with urllib.request.urlopen(f"{base}/api/cluster", timeout=30) as r:
+        cluster = json.loads(r.read())
+    assert cluster["nodes"] == 1
+    with urllib.request.urlopen(f"{base}/api/nodes", timeout=30) as r:
+        nodes = json.loads(r.read())["nodes"]
+    assert nodes[0]["state"] == "ALIVE"
+    with urllib.request.urlopen(f"{base}/", timeout=30) as r:
+        html = r.read().decode()
+    assert "ray_tpu cluster" in html
+
+    client = JobSubmissionClient(base)  # REST transport
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('VIA_REST')\""
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(sid) == JobStatus.SUCCEEDED:
+            break
+        time.sleep(0.3)
+    assert client.get_job_status(sid) == JobStatus.SUCCEEDED
+    assert "VIA_REST" in client.get_job_logs(sid)
+
+
+def test_cli(job_cluster, tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", *args],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+
+    out = cli("status", "--address", job_cluster)
+    assert out.returncode == 0, out.stderr
+    assert "1 alive" in out.stdout
+
+    out = cli("nodes", "--address", job_cluster)
+    assert out.returncode == 0 and "head=True" in out.stdout
+
+    trace = tmp_path / "t.json"
+    out = cli("timeline", "--address", job_cluster, "-o", str(trace))
+    assert out.returncode == 0
+    json.loads(trace.read_text())  # valid JSON
+
+    out = cli("job", "--address", job_cluster, "submit", "--",
+              sys.executable, "-c", "print(40+2)")
+    assert out.returncode == 0, out.stderr
+    sid = out.stdout.strip().splitlines()[-1]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = cli("job", "--address", job_cluster, "status", sid)
+        if st.stdout.strip() in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.5)
+    assert st.stdout.strip() == "SUCCEEDED"
+    logs = cli("job", "--address", job_cluster, "logs", sid)
+    assert "42" in logs.stdout

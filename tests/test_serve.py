@@ -203,7 +203,7 @@ def test_autoscaling_scale_up(serve_cluster):
 
     handle = serve.run(Slow.bind(), name="slow", route_prefix="/slow")
     controller = ray_tpu.get_actor("SERVE_CONTROLLER")
-    assert len(ray_tpu.get(controller.get_replica_names.remote("Slow"))) == 1
+    assert len(ray_tpu.get(controller.get_replica_names.remote("slow#Slow"))) == 1
 
     # Sustained concurrent load >> target_ongoing_requests per replica.
     deadline = time.time() + 45
@@ -213,7 +213,7 @@ def test_autoscaling_scale_up(serve_cluster):
         pending = [p for p in pending if not _done(p)][:16]
         while len(pending) < 8:
             pending.append(handle.remote())
-        names = ray_tpu.get(controller.get_replica_names.remote("Slow"))
+        names = ray_tpu.get(controller.get_replica_names.remote("slow#Slow"))
         grew = len(names) > 1
         time.sleep(0.3)
     assert grew, "autoscaler never added a replica under load"
