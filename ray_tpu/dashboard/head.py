@@ -29,10 +29,16 @@ logger = logging.getLogger("ray_tpu.dashboard")
 
 
 class DashboardHead:
-    def __init__(self, gcs_address: str):
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1"):
+        # Loopback by default: /api/jobs executes arbitrary entrypoints, so
+        # exposing it beyond the host must be an explicit operator choice
+        # (reference: the dashboard binds localhost unless configured).
         self.gcs_address = gcs_address
+        self.host = host
         self._server: Optional[asyncio.AbstractServer] = None
         self.port = 0
+        self._gcs = None
+        self._mgr = None
 
     # --------------------------------------------------------- data access
 
@@ -41,22 +47,27 @@ class DashboardHead:
 
         return state
 
+    def _gcs_client(self):
+        if self._gcs is None:
+            from ray_tpu._private.gcs.client import GcsClient
+
+            self._gcs = GcsClient.from_address(self.gcs_address)
+        return self._gcs
+
     def _job_manager(self):
-        from ray_tpu._private.gcs.client import GcsClient
-        from ray_tpu.job_submission import JobManager
+        if self._mgr is None:
+            from ray_tpu.job_submission import JobManager
 
-        return JobManager(GcsClient.from_address(self.gcs_address))
+            self._mgr = JobManager(self._gcs_client())
+        return self._mgr
 
-    def _collect(self, path: str, method: str, body: Optional[dict]):
+    def _collect(self, path: str, method: str, body: Optional[dict], query=None):
         """Blocking handler (run in executor): returns (status, payload)."""
         state = self._state()
         addr = self.gcs_address
         if path == "/api/cluster":
-            from ray_tpu._private.gcs.client import GcsClient
-
-            gcs = GcsClient.from_address(addr)
             return 200, {
-                "cluster": gcs.get_cluster_resources(),
+                "cluster": self._gcs_client().get_cluster_resources(),
                 "nodes": len(state.list_nodes(addr)),
             }
         if path == "/api/nodes":
@@ -76,12 +87,12 @@ class DashboardHead:
 
             return 200, {"version": version}
         if path.startswith("/api/jobs"):
-            return self._jobs_api(path, method, body)
+            return self._jobs_api(path, method, body, query or {})
         if path == "/" or path == "/index.html":
             return 200, None  # HTML handled by caller
         return 404, {"error": f"no route {path}"}
 
-    def _jobs_api(self, path: str, method: str, body: Optional[dict]):
+    def _jobs_api(self, path: str, method: str, body: Optional[dict], query):
         mgr = self._job_manager()
         parts = [p for p in path.split("/") if p]  # ["api","jobs",...]
         if len(parts) == 2:
@@ -102,7 +113,8 @@ class DashboardHead:
             if len(parts) == 3 and method == "GET":
                 return 200, mgr.get_job_info(sid)
             if len(parts) == 4 and parts[3] == "logs":
-                return 200, {"logs": mgr.get_job_logs(sid)}
+                offset = int(query.get("offset", 0) or 0)
+                return 200, {"logs": mgr.get_job_logs(sid, offset)}
             if len(parts) == 4 and parts[3] == "stop" and method == "POST":
                 return 200, {"stopped": mgr.stop_job(sid)}
         except ValueError as e:
@@ -136,7 +148,13 @@ class DashboardHead:
             parts = request_line.decode("latin-1").split()
             if len(parts) < 2:
                 return
-            method, path = parts[0], parts[1].split("?")[0]
+            raw_path = parts[1]
+            method, path = parts[0], raw_path.split("?")[0]
+            query = {}
+            if "?" in raw_path:
+                for kv in raw_path.split("?", 1)[1].split("&"):
+                    k, _, v = kv.partition("=")
+                    query[k] = v
             headers = {}
             while True:
                 line = await asyncio.wait_for(reader.readline(), 10)
@@ -155,7 +173,7 @@ class DashboardHead:
             loop = asyncio.get_running_loop()
             try:
                 status, payload = await loop.run_in_executor(
-                    None, self._collect, path, method, body
+                    None, self._collect, path, method, body, query
                 )
             except Exception as e:
                 logger.exception("dashboard handler failed")
@@ -183,9 +201,9 @@ class DashboardHead:
                 pass
 
     async def start(self, port: int = 0) -> int:
-        self._server = await asyncio.start_server(self._handle, "0.0.0.0", port)
+        self._server = await asyncio.start_server(self._handle, self.host, port)
         self.port = self._server.sockets[0].getsockname()[1]
-        logger.info("dashboard on http://127.0.0.1:%d", self.port)
+        logger.info("dashboard on http://%s:%d", self.host, self.port)
         return self.port
 
 
@@ -205,12 +223,15 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs-address", required=True)
     parser.add_argument("--port", type=int, default=8265)
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address; 0.0.0.0 exposes job execution "
+                             "to the network — opt in deliberately")
     parser.add_argument("--port-file", default="")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
     async def run():
-        head = DashboardHead(args.gcs_address)
+        head = DashboardHead(args.gcs_address, host=args.host)
         port = await head.start(args.port)
         if args.port_file:
             import os

@@ -97,10 +97,12 @@ class JobSubmissionClient:
             return self._req("GET", f"/api/jobs/{submission_id}")
         return self._mgr.get_job_info(submission_id)
 
-    def get_job_logs(self, submission_id: str) -> str:
+    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
         if self._http:
-            return self._req("GET", f"/api/jobs/{submission_id}/logs")["logs"]
-        return self._mgr.get_job_logs(submission_id)
+            return self._req(
+                "GET", f"/api/jobs/{submission_id}/logs?offset={offset}"
+            )["logs"]
+        return self._mgr.get_job_logs(submission_id, offset)
 
     def stop_job(self, submission_id: str) -> bool:
         if self._http:
@@ -113,19 +115,20 @@ class JobSubmissionClient:
         return self._mgr.list_jobs()
 
     def tail_job_logs(self, submission_id: str):
+        """Yield new log chunks; each poll transfers only unseen bytes."""
         import time
 
         offset = 0
         while True:
-            logs = self.get_job_logs(submission_id)
-            if len(logs) > offset:
-                yield logs[offset:]
-                offset = len(logs)
+            chunk = self.get_job_logs(submission_id, offset=offset)
+            if chunk:
+                yield chunk
+                offset += len(chunk.encode("utf-8", "replace"))
             status = self.get_job_status(submission_id)
             if status in (JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED):
-                logs = self.get_job_logs(submission_id)
-                if len(logs) > offset:
-                    yield logs[offset:]
+                chunk = self.get_job_logs(submission_id, offset=offset)
+                if chunk:
+                    yield chunk
                 return
             time.sleep(0.5)
 

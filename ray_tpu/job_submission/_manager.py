@@ -45,17 +45,23 @@ class JobSupervisor:
     def _set_status(self, status: str, message: str = ""):
         from ray_tpu._private import worker as worker_mod
 
-        info = {
-            "submission_id": self.submission_id,
-            "entrypoint": self.entrypoint,
-            "status": status,
-            "message": message,
-            "start_time": getattr(self, "_start_time", None),
-            "end_time": time.time() if status in _TERMINAL else None,
-        }
-        worker_mod.global_worker.gcs.kv_put(
-            JOB_KV_NS, self.submission_id.encode(), json.dumps(info).encode()
+        gcs = worker_mod.global_worker.gcs
+        key = self.submission_id.encode()
+        # Read-modify-write: preserve submit-time fields (metadata, ...).
+        try:
+            info = json.loads(gcs.kv_get(JOB_KV_NS, key) or b"{}")
+        except Exception:
+            info = {}
+        info.update(
+            submission_id=self.submission_id,
+            entrypoint=self.entrypoint,
+            status=status,
+            message=message,
+            start_time=getattr(self, "_start_time", None),
+            end_time=time.time() if status in _TERMINAL else None,
+            log_path=self.log_path,
         )
+        gcs.kv_put(JOB_KV_NS, key, json.dumps(info).encode())
 
     async def start(self) -> bool:
         """Spawn the entrypoint subprocess. The submitter blocks on this so
@@ -97,13 +103,23 @@ class JobSupervisor:
         finally:
             logf.close()
         if self._stopped:
-            self._set_status(STOPPED, "stopped by user")
-            return STOPPED
-        if rc == 0:
-            self._set_status(SUCCEEDED)
-            return SUCCEEDED
-        self._set_status(FAILED, f"entrypoint exited with code {rc}")
-        return FAILED
+            status, msg = STOPPED, "stopped by user"
+        elif rc == 0:
+            status, msg = SUCCEEDED, ""
+        else:
+            status, msg = FAILED, f"entrypoint exited with code {rc}"
+        self._set_status(status, msg)
+        # Self-terminate after a grace period (reference: the supervisor
+        # actor exits with the job) — the log file outlives the actor and
+        # queries fall back to it; without this every job leaks a detached
+        # actor forever.
+        asyncio.get_running_loop().call_later(60.0, self._exit_self)
+        return status
+
+    def _exit_self(self):
+        import os as _os
+
+        _os._exit(0)
 
     async def run(self) -> str:
         """Start and block until terminal (in-process convenience)."""
@@ -126,9 +142,11 @@ class JobSupervisor:
             return True
         return False
 
-    async def get_logs(self) -> str:
+    async def get_logs(self, offset: int = 0) -> str:
         try:
             with open(self.log_path, "rb") as f:
+                if offset:
+                    f.seek(offset)
                 return f.read().decode("utf-8", "replace")
         except OSError:
             return ""
@@ -241,14 +259,25 @@ class JobManager:
         out.sort(key=lambda j: j.get("start_time") or 0)
         return out
 
-    def get_job_logs(self, submission_id: str) -> str:
+    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
         import ray_tpu
 
+        info = self.get_job_info(submission_id)  # raises on unknown id
+        # The log file outlives the (self-terminating) supervisor actor;
+        # prefer it when reachable, fall back to the actor for remote logs.
+        log_path = info.get("log_path")
+        if log_path and os.path.exists(log_path):
+            try:
+                with open(log_path, "rb") as f:
+                    if offset:
+                        f.seek(offset)
+                    return f.read().decode("utf-8", "replace")
+            except OSError:
+                pass
         self._ensure_connected()
-        self.get_job_info(submission_id)  # raises on unknown id
         try:
             sup = ray_tpu.get_actor(f"JOB_SUP::{submission_id}")
-            return ray_tpu.get(sup.get_logs.remote(), timeout=30)
+            return ray_tpu.get(sup.get_logs.remote(offset), timeout=30)
         except Exception:
             return ""
 

@@ -160,19 +160,17 @@ def num_params(params) -> int:
 
 # Megatron-style tensor-parallel layout + fsdp on the complementary dim.
 # Rule paths match flax param pytree paths like 'h_3/attn/c_attn/kernel'.
-GPT2_SHARDING_RULES = ShardingRules(
-    [
-        (r"wte/embedding", P("tp", "fsdp")),
-        (r"wpe/embedding", P(None, "fsdp")),
-        (r"attn/c_attn/kernel", P("fsdp", "tp")),   # column parallel
-        (r"attn/c_attn/bias", P("tp")),
-        (r"attn/c_proj/kernel", P("tp", "fsdp")),   # row parallel
-        (r"attn/c_proj/bias", P()),
-        (r"mlp/c_fc/kernel", P("fsdp", "tp")),
-        (r"mlp/c_fc/bias", P("tp")),
-        (r"mlp/c_proj/kernel", P("tp", "fsdp")),
-        (r"mlp/c_proj/bias", P()),
-        (r"ln_", P()),
-    ],
-    default=P(),
-)
+GPT2_SHARDING_PATTERNS = [
+    (r"wte/embedding", P("tp", "fsdp")),
+    (r"wpe/embedding", P(None, "fsdp")),
+    (r"attn/c_attn/kernel", P("fsdp", "tp")),   # column parallel
+    (r"attn/c_attn/bias", P("tp")),
+    (r"attn/c_proj/kernel", P("tp", "fsdp")),   # row parallel
+    (r"attn/c_proj/bias", P()),
+    (r"mlp/c_fc/kernel", P("fsdp", "tp")),
+    (r"mlp/c_fc/bias", P("tp")),
+    (r"mlp/c_proj/kernel", P("tp", "fsdp")),
+    (r"mlp/c_proj/bias", P()),
+    (r"ln_", P()),
+]
+GPT2_SHARDING_RULES = ShardingRules(GPT2_SHARDING_PATTERNS, default=P())

@@ -61,8 +61,9 @@ def _ring_attn_for_mesh(mesh: Mesh, seq_axis: str = "sp"):
     return fn
 
 
-def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]) -> GPT2:
-    """Instantiate GPT2 wired for this mesh: ring attention iff sp > 1."""
+def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]):
+    """Instantiate the model wired for this mesh: ring attention iff sp > 1;
+    a GPT2MoEConfig yields the expert-parallel variant (ep mesh axis)."""
     import dataclasses
 
     if (
@@ -71,6 +72,10 @@ def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]) -> GPT2:
         and mesh.shape["sp"] > 1
     ):
         cfg = dataclasses.replace(cfg, attn_fn=_ring_attn_for_mesh(mesh))
+    from ray_tpu.models.gpt2_moe import GPT2MoE, GPT2MoEConfig
+
+    if isinstance(cfg, GPT2MoEConfig):
+        return GPT2MoE(cfg)
     return GPT2(cfg)
 
 
@@ -92,8 +97,13 @@ class TrainStep:
         weight_decay: float = 0.1,
         beta2: float = 0.95,
         grad_clip: float = 1.0,
-        rules: ShardingRules = GPT2_SHARDING_RULES,
+        rules: Optional[ShardingRules] = None,
     ):
+        from ray_tpu.models.gpt2_moe import GPT2_MOE_SHARDING_RULES, GPT2MoEConfig
+
+        self._is_moe = isinstance(model_cfg, GPT2MoEConfig)
+        if rules is None:
+            rules = GPT2_MOE_SHARDING_RULES if self._is_moe else GPT2_SHARDING_RULES
         self.model_cfg = model_cfg
         self.mesh = mesh
         self.model = gpt2_model_for_mesh(model_cfg, mesh)
@@ -109,7 +119,7 @@ class TrainStep:
         def init_fn(rng):
             T = min(8, model_cfg.block_size)
             idx = jnp.zeros((2, T), dtype=jnp.int32)
-            params = GPT2(model_cfg).init(rng, idx)["params"]
+            params = self.model.init(rng, idx)["params"]
             return {
                 "params": params,
                 "opt_state": self.optimizer.init(params),
@@ -124,6 +134,12 @@ class TrainStep:
 
         def step_fn(state, batch):
             def loss_of(params):
+                if self._is_moe:
+                    logits, lstate = self.model.apply(
+                        {"params": params}, batch["idx"], mutable=["losses"]
+                    )
+                    aux = sum(jax.tree.leaves(lstate.get("losses", {})))
+                    return loss_fn(logits, batch["targets"]) + aux
                 logits = self.model.apply({"params": params}, batch["idx"])
                 return loss_fn(logits, batch["targets"])
 
