@@ -145,6 +145,12 @@ class Executor:
         sem_holder = self
 
         async def run_on_actor_loop():
+            tctx = spec.get("trace_ctx")
+            if tctx:
+                from ray_tpu.util import tracing
+
+                tracing._mark_enabled()
+                tracing.set_context(dict(tctx))  # task-local contextvar copy
             if sem_holder._actor_sem is None:
                 sem_holder._actor_sem = asyncio.Semaphore(sem_holder._actor_max_conc)
             async with sem_holder._actor_sem:
@@ -222,7 +228,20 @@ class Executor:
         old_ctx = self.core.push_task_context(spec)
 
         def call():
-            return fn(*args, **kwargs)
+            tctx = spec.get("trace_ctx")
+            if tctx:
+                # Restore the caller's trace context in the execution thread
+                # so user spans + nested submits stay on the same trace
+                # (reference: _ray_trace_ctx kwarg propagation).
+                from ray_tpu.util import tracing
+
+                tracing._mark_enabled()
+                tracing.set_context(dict(tctx))
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                if tctx:
+                    tracing.set_context(None)
 
         try:
             result = await loop.run_in_executor(pool, call)

@@ -24,6 +24,27 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
         evs.sort(key=lambda e: e["ts"])
         running_ev = None
         for ev in evs:
+            if ev["state"] == "SPAN":
+                # User/tracing span (ray_tpu.util.tracing) — duration baked in.
+                out.append(
+                    {
+                        "cat": "span",
+                        "name": ev.get("name") or "span",
+                        "ph": "X",
+                        "ts": ev["ts"] * 1e6,
+                        "dur": max(0.0, ev.get("dur", 0.0) * 1e6),
+                        "pid": f"node:{(ev.get('node_id') or '?')[:8]}",
+                        "tid": f"worker:{(ev.get('worker_id') or '?')[:8]}",
+                        "args": {
+                            "trace_id": ev.get("trace_id", ""),
+                            "span_id": ev.get("task_id", ""),
+                            "parent_span_id": ev.get("parent_span_id", ""),
+                            **(ev.get("attributes") or {}),
+                            "error": ev.get("error", ""),
+                        },
+                    }
+                )
+                continue
             if ev["state"] == "RUNNING":
                 running_ev = ev
             elif ev["state"] in _TERMINAL and running_ev is not None:

@@ -122,6 +122,32 @@ class TaskEventBuffer:
             if len(self._events) > RTPU_CONFIG.task_events_max_buffer:
                 del self._events[: len(self._events) // 2]
 
+    def record_span(
+        self, name: str, start: float, end: float, ctx: dict,
+        attributes: dict, error: str = "",
+    ):
+        """User/tracing span (ray_tpu.util.tracing) — rides the same buffer
+        and GCS sink as task state events; rendered by timeline()."""
+        ev = {
+            "task_id": ctx.get("span_id", ""),
+            "name": name,
+            "job_id": self.core.job_id.hex() if self.core.job_id else "",
+            "state": "SPAN",
+            "ts": start,
+            "dur": end - start,
+            "node_id": self.core.node_id.hex() if self.core.node_id else "",
+            "worker_id": self.core.worker_id.hex(),
+            "error": error,
+            "actor_id": "",
+            "trace_id": ctx.get("trace_id", ""),
+            "parent_span_id": ctx.get("parent_span_id", ""),
+            "attributes": {str(k): str(v) for k, v in attributes.items()},
+        }
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > RTPU_CONFIG.task_events_max_buffer:
+                del self._events[: len(self._events) // 2]
+
     def drain(self) -> List[dict]:
         with self._lock:
             out, self._events = self._events, []
@@ -771,6 +797,9 @@ class CoreWorker:
         big_refs = self._replace_large_args(wire, large)
         refs.extend(big_refs)
         task_id = TaskID.for_task(self.job_id)
+        from ray_tpu.util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
         spec = ts.build_task_spec(
             task_id=task_id,
             job_id=self.job_id,
@@ -787,6 +816,8 @@ class CoreWorker:
             caller_id=self.worker_id.binary(),
             runtime_env=runtime_env,
         )
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
         return_refs = self._register_pending(spec, refs)
         self.io.post(self._submit_normal(spec))
         return return_refs
@@ -1242,6 +1273,11 @@ class CoreWorker:
             method_name=method_name,
             caller_id=self.worker_id.binary(),
         )
+        from ray_tpu.util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
         return_refs = self._register_pending(spec, refs)
         self.io.post(self._submit_actor_task(actor_id, spec))
         return return_refs

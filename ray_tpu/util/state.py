@@ -122,6 +122,8 @@ def list_tasks(
     latest: Dict[str, dict] = {}
     first_ts: Dict[str, float] = {}
     for ev in events:
+        if ev.get("state") == "SPAN":
+            continue  # tracing spans share the sink but are not tasks
         tid = ev["task_id"]
         first_ts.setdefault(tid, ev["ts"])
         cur = latest.get(tid)

@@ -1,0 +1,93 @@
+"""Distributed tracing: span recording + cross-process context propagation.
+
+Reference contract: tracing is opt-in and the trace context follows remote
+calls into workers (python/ray/util/tracing/tracing_helper.py — the
+injected _ray_trace_ctx); spans land in the timeline.
+"""
+
+import time
+
+import pytest
+
+
+def test_spans_record_and_propagate(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+
+        @ray_tpu.remote
+        def traced_task():
+            from ray_tpu.util import tracing as t
+
+            ctx = t.current_context()
+            with t.span("inner-work", {"k": "v"}):
+                time.sleep(0.01)
+            return ctx
+
+        with tracing.span("driver-root") as root:
+            worker_ctx = ray_tpu.get(traced_task.remote())
+
+        # The worker saw the SAME trace id as the driver's root span.
+        assert worker_ctx is not None
+        assert worker_ctx["trace_id"] == root["trace_id"]
+        # ...and its parent span is the driver's root span.
+        assert worker_ctx.get("span_id") == root["span_id"]
+
+        # Spans flush with the task events and appear in the timeline.
+        deadline = time.time() + 15
+        spans = []
+        while time.time() < deadline:
+            events = ray_tpu.timeline()
+            spans = [e for e in events if e.get("cat") == "span"]
+            if len(spans) >= 2:
+                break
+            time.sleep(0.3)
+        names = {s["name"] for s in spans}
+        assert {"driver-root", "inner-work"} <= names
+        inner = next(s for s in spans if s["name"] == "inner-work")
+        assert inner["args"]["trace_id"] == root["trace_id"]
+        assert inner["args"]["k"] == "v"
+        assert inner["dur"] >= 0.01 * 1e6 * 0.5
+    finally:
+        tracing.disable()
+
+
+def test_actor_trace_propagation(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+
+        @ray_tpu.remote
+        class Traced:
+            def ctx(self):
+                from ray_tpu.util import tracing as t
+
+                return t.current_context()
+
+        a = Traced.remote()
+        with tracing.span("actor-call-root") as root:
+            ctx = ray_tpu.get(a.ctx.remote())
+        assert ctx is not None and ctx["trace_id"] == root["trace_id"]
+    finally:
+        tracing.disable()
+
+
+def test_disabled_is_no_op(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    assert not tracing.is_enabled()
+    with tracing.span("nothing") as s:
+        assert s is None
+
+    @ray_tpu.remote
+    def f():
+        from ray_tpu.util import tracing as t
+
+        return t.current_context()
+
+    assert ray_tpu.get(f.remote()) is None
