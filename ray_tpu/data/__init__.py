@@ -77,20 +77,10 @@ def _read_parquet_task(path: str, columns):
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
     """One block per parquet file, read in parallel by tasks
     (reference: data.read_parquet / datasource/parquet_datasource)."""
-    import glob
-    import os
-
-    if isinstance(paths, str):
-        paths = [paths]
-    files: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            files.extend(sorted(glob.glob(os.path.join(p, "*.parquet"))))
-        else:
-            files.extend(sorted(glob.glob(p)) or [p])
-    if not files:
-        raise FileNotFoundError(f"no parquet files under {paths}")
-    refs = [_read_parquet_task.remote(f, columns) for f in files]
+    refs = [
+        _read_parquet_task.remote(f, columns)
+        for f in _expand_files(paths, ".parquet")
+    ]
     return Dataset(refs)
 
 
@@ -106,6 +96,11 @@ def _read_csv_task(path: str):
 
 
 def read_csv(paths) -> Dataset:
+    refs = [_read_csv_task.remote(f) for f in _expand_files(paths, ".csv")]
+    return Dataset(refs)
+
+
+def _expand_files(paths, suffix: str) -> List[str]:
     import glob
     import os
 
@@ -114,10 +109,62 @@ def read_csv(paths) -> Dataset:
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            files.extend(sorted(glob.glob(os.path.join(p, "*.csv"))))
+            files.extend(sorted(glob.glob(os.path.join(p, f"*{suffix}"))))
         else:
             files.extend(sorted(glob.glob(p)) or [p])
     if not files:
-        raise FileNotFoundError(f"no csv files under {paths}")
-    refs = [_read_csv_task.remote(f) for f in files]
+        raise FileNotFoundError(f"no {suffix} files under {paths}")
+    return files
+
+
+@ray_tpu.remote
+def _read_json_task(path: str):
+    import pyarrow.json as pjson
+
+    table = pjson.read_json(path)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_json(paths) -> Dataset:
+    """Newline-delimited JSON, one block per file
+    (reference: data.read_json / datasource/json_datasource)."""
+    refs = [_read_json_task.remote(f) for f in _expand_files(paths, ".json")]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_text_task(path: str):
+    with open(path) as f:
+        return {"text": np.asarray([ln.rstrip("\n") for ln in f], dtype=object)}
+
+
+def read_text(paths) -> Dataset:
+    """One row per line (reference: data.read_text)."""
+    refs = [_read_text_task.remote(f) for f in _expand_files(paths, ".txt")]
+    return Dataset(refs)
+
+
+def from_pandas(dfs) -> Dataset:
+    """One block per DataFrame (reference: data.from_pandas)."""
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    refs = [
+        ray_tpu.put({c: df[c].to_numpy() for c in df.columns}) for df in dfs
+    ]
+    return Dataset(refs)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    refs = [
+        ray_tpu.put({
+            name: t.column(name).to_numpy(zero_copy_only=False)
+            for name in t.column_names
+        })
+        for t in tables
+    ]
     return Dataset(refs)

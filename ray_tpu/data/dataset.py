@@ -199,6 +199,159 @@ class Dataset:
             self._extra_legs + [other],
         )
 
+    def limit(self, n: int) -> "Dataset":
+        """Materializing head-n (reference: Dataset.limit)."""
+        rows = self.take(n)
+        from ray_tpu.data._streaming import _rows_to_block
+
+        return Dataset([ray_tpu.put(_rows_to_block(rows))] if rows else [])
+
+    def sort(self, key: Union[str, Callable, None] = None,
+             descending: bool = False) -> "Dataset":
+        """Materializing global sort (reference: Dataset.sort; the reference
+        does a distributed sample-sort — at our block counts a single
+        concat+argsort is both simpler and faster)."""
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        if n == 0:
+            return Dataset([])
+        if isinstance(whole, dict):
+            if key is None:
+                key = next(iter(whole))
+            order = np.argsort(np.asarray(whole[key]), kind="stable")
+            if descending:
+                order = order[::-1]
+            out: Block = {k: np.asarray(v)[order] for k, v in whole.items()}
+        else:
+            out = sorted(whole, key=key, reverse=descending)
+        return Dataset([ray_tpu.put(out)])
+
+    def unique(self, column: str) -> List[Any]:
+        vals = set()
+        for block in self.iter_batches(batch_size=None):
+            if isinstance(block, dict):
+                vals.update(np.asarray(block[column]).tolist())
+            else:
+                vals.update(r[column] for r in block)
+        return sorted(vals)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Materializing columnar zip of equal-length datasets
+        (reference: Dataset.zip)."""
+        a = concat_blocks([ray_tpu.get(r) for r in self._iter_block_refs()])
+        b = concat_blocks([ray_tpu.get(r) for r in other._iter_block_refs()])
+        if block_num_rows(a) != block_num_rows(b):
+            raise ValueError("zip requires equal row counts")
+        if block_num_rows(a) == 0:
+            return Dataset([])
+        if not (isinstance(a, dict) and isinstance(b, dict)):
+            raise TypeError("zip requires column blocks")
+        merged = dict(a)
+        for k, v in b.items():
+            merged[k if k not in merged else f"{k}_1"] = v
+        return Dataset([ray_tpu.put(merged)])
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------------------------------------------- simple aggregates
+
+    def _column(self, column: str) -> np.ndarray:
+        parts = [
+            np.asarray(b[column])
+            for b in self.iter_batches(batch_size=None)
+            if block_num_rows(b)
+        ]
+        return np.concatenate(parts) if parts else np.array([])
+
+    def sum(self, column: str):
+        return self._column(column).sum().item()
+
+    def mean(self, column: str):
+        return self._column(column).mean().item()
+
+    def min(self, column: str):
+        return self._column(column).min().item()
+
+    def max(self, column: str):
+        return self._column(column).max().item()
+
+    def std(self, column: str, ddof: int = 1):
+        return self._column(column).std(ddof=ddof).item()
+
+    # -------------------------------------------------------------- writes
+
+    def _column_blocks(self):
+        for i, ref in enumerate(self._iter_block_refs()):
+            block = ray_tpu.get(ref)
+            if not isinstance(block, dict):
+                from ray_tpu.data._streaming import _rows_to_block
+
+                block = _rows_to_block(list(rows_of(block)))
+                if not isinstance(block, dict):
+                    block = {"value": np.asarray(block, dtype=object)}
+            yield i, block
+
+    def write_parquet(self, path: str) -> List[str]:
+        """One file per block under `path`
+        (reference: Dataset.write_parquet)."""
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in self._column_blocks():
+            fp = os.path.join(path, f"part-{i:05d}.parquet")
+            pq.write_table(pa.table(dict(block)), fp)
+            out.append(fp)
+        return out
+
+    def write_csv(self, path: str) -> List[str]:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.csv as pcsv
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in self._column_blocks():
+            fp = os.path.join(path, f"part-{i:05d}.csv")
+            pcsv.write_csv(pa.table(dict(block)), fp)
+            out.append(fp)
+        return out
+
+    def write_json(self, path: str) -> List[str]:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, ref in enumerate(self._iter_block_refs()):
+            fp = os.path.join(path, f"part-{i:05d}.json")
+            with open(fp, "w") as f:
+                for row in rows_of(ray_tpu.get(ref)):
+                    if isinstance(row, dict):
+                        row = {
+                            k: v.item() if isinstance(v, np.generic) else v
+                            for k, v in row.items()
+                        }
+                    f.write(json.dumps(row) + "\n")
+            out.append(fp)
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        whole = concat_blocks(
+            [ray_tpu.get(r) for r in self._iter_block_refs()]
+        )
+        if isinstance(whole, dict):
+            return pd.DataFrame(dict(whole))
+        return pd.DataFrame({"value": list(whole)})
+
     # ---------------------------------------------------------- consumption
 
     def _iter_block_refs(self) -> Iterator[Any]:
@@ -262,3 +415,84 @@ class Dataset:
         ops = " -> ".join(op.name for op in self._operators) or "source"
         return (f"Dataset(num_blocks={len(self._source_refs)}, "
                 f"plan={ops})")
+
+
+class GroupedData:
+    """Hash-group aggregation on column blocks
+    (reference: python/ray/data/grouped_data.py — the aggregate subset)."""
+
+    _AGGS = {
+        "count": lambda v: len(v),
+        "sum": lambda v: np.sum(v).item(),
+        "mean": lambda v: np.mean(v).item(),
+        "min": lambda v: np.min(v).item(),
+        "max": lambda v: np.max(v).item(),
+        "std": lambda v: np.std(v, ddof=1).item() if len(v) > 1 else 0.0,
+    }
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _grouped(self):
+        whole = concat_blocks(
+            [ray_tpu.get(r) for r in self._ds._iter_block_refs()]
+        )
+        if block_num_rows(whole) == 0:
+            # concat of zero blocks is [] regardless of block kind
+            whole = {self._key: np.array([])}
+        if not isinstance(whole, dict):
+            raise TypeError("groupby requires column blocks")
+        keys = np.asarray(whole[self._key])
+        order = np.argsort(keys, kind="stable")
+        sorted_keys = keys[order]
+        uniq, starts = np.unique(sorted_keys, return_index=True)
+        bounds = list(starts) + [len(sorted_keys)]
+        return whole, order, uniq, bounds
+
+    def _agg(self, column: str, how: str) -> Dataset:
+        whole, order, uniq, bounds = self._grouped()
+        if len(uniq) == 0:
+            return Dataset([ray_tpu.put({
+                self._key: uniq, f"{how}({column})": np.array([]),
+            })])
+        vals = np.asarray(whole[column])[order]
+        fn = self._AGGS[how]
+        out = [fn(vals[bounds[i]:bounds[i + 1]]) for i in range(len(uniq))]
+        return Dataset([ray_tpu.put({
+            self._key: uniq, f"{how}({column})": np.asarray(out),
+        })])
+
+    def count(self) -> Dataset:
+        whole, order, uniq, bounds = self._grouped()
+        out = [bounds[i + 1] - bounds[i] for i in range(len(uniq))]
+        return Dataset([ray_tpu.put({
+            self._key: uniq, "count()": np.asarray(out),
+        })])
+
+    def sum(self, column: str) -> Dataset:
+        return self._agg(column, "sum")
+
+    def mean(self, column: str) -> Dataset:
+        return self._agg(column, "mean")
+
+    def min(self, column: str) -> Dataset:
+        return self._agg(column, "min")
+
+    def max(self, column: str) -> Dataset:
+        return self._agg(column, "max")
+
+    def std(self, column: str) -> Dataset:
+        return self._agg(column, "std")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn to each group's sub-block; concat the results."""
+        whole, order, uniq, bounds = self._grouped()
+        if len(uniq) == 0:
+            return Dataset([])
+        sorted_block = {k: np.asarray(v)[order] for k, v in whole.items()}
+        outs = []
+        for i in range(len(uniq)):
+            sub = slice_block(sorted_block, bounds[i], bounds[i + 1])
+            outs.append(fn(sub))
+        return Dataset([ray_tpu.put(o) for o in outs])

@@ -61,9 +61,9 @@ def _ring_attn_for_mesh(mesh: Mesh, seq_axis: str = "sp"):
     return fn
 
 
-def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]):
+def model_for_mesh(cfg, mesh: Optional[Mesh]):
     """Instantiate the model wired for this mesh: ring attention iff sp > 1;
-    a GPT2MoEConfig yields the expert-parallel variant (ep mesh axis)."""
+    config type picks the family (GPT2 / GPT2MoE with an ep axis / Llama)."""
     import dataclasses
 
     if (
@@ -73,10 +73,28 @@ def gpt2_model_for_mesh(cfg: GPT2Config, mesh: Optional[Mesh]):
     ):
         cfg = dataclasses.replace(cfg, attn_fn=_ring_attn_for_mesh(mesh))
     from ray_tpu.models.gpt2_moe import GPT2MoE, GPT2MoEConfig
+    from ray_tpu.models.llama import Llama, LlamaConfig
 
     if isinstance(cfg, GPT2MoEConfig):
         return GPT2MoE(cfg)
+    if isinstance(cfg, LlamaConfig):
+        return Llama(cfg)
     return GPT2(cfg)
+
+
+# Backwards-compatible alias (pre-Llama name).
+gpt2_model_for_mesh = model_for_mesh
+
+
+def default_rules_for(cfg) -> ShardingRules:
+    from ray_tpu.models.gpt2_moe import GPT2_MOE_SHARDING_RULES, GPT2MoEConfig
+    from ray_tpu.models.llama import LLAMA_SHARDING_RULES, LlamaConfig
+
+    if isinstance(cfg, GPT2MoEConfig):
+        return GPT2_MOE_SHARDING_RULES
+    if isinstance(cfg, LlamaConfig):
+        return LLAMA_SHARDING_RULES
+    return GPT2_SHARDING_RULES
 
 
 class TrainStep:
@@ -99,14 +117,14 @@ class TrainStep:
         grad_clip: float = 1.0,
         rules: Optional[ShardingRules] = None,
     ):
-        from ray_tpu.models.gpt2_moe import GPT2_MOE_SHARDING_RULES, GPT2MoEConfig
+        from ray_tpu.models.gpt2_moe import GPT2MoEConfig
 
         self._is_moe = isinstance(model_cfg, GPT2MoEConfig)
         if rules is None:
-            rules = GPT2_MOE_SHARDING_RULES if self._is_moe else GPT2_SHARDING_RULES
+            rules = default_rules_for(model_cfg)
         self.model_cfg = model_cfg
         self.mesh = mesh
-        self.model = gpt2_model_for_mesh(model_cfg, mesh)
+        self.model = model_for_mesh(model_cfg, mesh)
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip),
             optax.adamw(

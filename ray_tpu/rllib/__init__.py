@@ -5,4 +5,5 @@ LearnerGroup, PPO. The torch-DDP learner is re-designed as a pjit'd update
 over a jax device mesh (north-star config 3: CPU rollouts + TPU learner).
 """
 
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401

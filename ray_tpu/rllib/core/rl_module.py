@@ -50,6 +50,38 @@ def numpy_forward(params, obs: np.ndarray):
     return logits, v[:, 0]
 
 
+class QModule(nn.Module):
+    """MLP Q-network for discrete action spaces (DQN family)."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_actions, name="q")(x)
+
+    def init_params(self, obs_dim: int, seed: int = 0):
+        return self.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim), jnp.float32)
+        )["params"]
+
+
+def numpy_q_forward(params, obs: np.ndarray):
+    """Numpy mirror of QModule for CPU env runners (relu hidden stack)."""
+    x = obs.astype(np.float32)
+    layers = sorted(k for k in params if k.startswith("Dense_"))
+    for k in layers:
+        x = np.maximum(
+            x @ np.asarray(params[k]["kernel"]) + np.asarray(params[k]["bias"]),
+            0.0,
+        )
+    return x @ np.asarray(params["q"]["kernel"]) + np.asarray(
+        params["q"]["bias"])
+
+
 def sample_actions(rng: np.random.Generator, logits: np.ndarray):
     """Categorical sample + log-prob, numpy."""
     z = logits - logits.max(axis=-1, keepdims=True)

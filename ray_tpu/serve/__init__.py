@@ -23,6 +23,7 @@ from ray_tpu.serve._deployment import (
 )
 from ray_tpu.serve._handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment",
@@ -34,6 +35,8 @@ __all__ = [
     "get_deployment_handle",
     "status",
     "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "Application",
     "AutoscalingConfig",
     "Deployment",

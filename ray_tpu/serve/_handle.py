@@ -37,24 +37,34 @@ class DeploymentResponse:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._method = method_name
+        self._model_id = multiplexed_model_id
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
         self._fetched_at = 0.0
         self._inflight: Dict[int, int] = {}  # replica index -> in-flight
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self._method))
+        return (DeploymentHandle,
+                (self.deployment_name, self._method, self._model_id))
 
-    def options(self, method_name: str = "__call__") -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id,
+        )
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name)
+        return DeploymentHandle(self.deployment_name, name, self._model_id)
 
     def _refresh_replicas(self, force: bool = False):
         now = time.time()
@@ -79,7 +89,10 @@ class DeploymentHandle:
             self._inflight = {i: 0 for i in range(len(replicas))}
 
     def _pick(self) -> tuple:
-        """Power-of-two-choices on handle-local in-flight counts."""
+        """Power-of-two-choices on handle-local in-flight counts; requests
+        tagged with a multiplexed model id get deterministic model→replica
+        affinity instead, so each model's weights stay warm on one replica
+        (reference: pow_2_scheduler.py multiplexed-model ranking)."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -88,6 +101,10 @@ class DeploymentHandle:
                 )
             if n == 1:
                 idx = 0
+            elif self._model_id:
+                import zlib
+
+                idx = zlib.crc32(self._model_id.encode()) % n
             else:
                 a, b = random.sample(range(n), 2)
                 idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
@@ -111,6 +128,9 @@ class DeploymentHandle:
                 time.sleep(0.25)
                 continue
             try:
+                if self._model_id:
+                    kwargs = {**kwargs,
+                              "__multiplexed_model_id": self._model_id}
                 ref = replica.handle_request.remote(
                     self._method, args, kwargs
                 )

@@ -44,6 +44,11 @@ class Replica:
         import asyncio
         import functools
 
+        model_id = kwargs.pop("__multiplexed_model_id", "")
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_current_model_id
+
+            _set_current_model_id(model_id)
         self._ongoing += 1
         try:
             if self._is_function:
@@ -56,10 +61,15 @@ class Replica:
                 return await target(*args, **kwargs)
             # Sync callables run in the thread pool so max_ongoing_requests
             # gives real concurrency and metadata/health stay responsive
-            # (reference: replica.py runs sync user methods off-loop).
+            # (reference: replica.py runs sync user methods off-loop). The
+            # request context (multiplexed model id) is copied into the
+            # worker thread explicitly — run_in_executor does not.
+            import contextvars
+
             loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()
             result = await loop.run_in_executor(
-                None, functools.partial(target, *args, **kwargs)
+                None, ctx.run, functools.partial(target, *args, **kwargs)
             )
             if inspect.iscoroutine(result):
                 result = await result

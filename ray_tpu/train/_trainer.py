@@ -73,10 +73,20 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self._resume_checkpoint = resume_from_checkpoint
         name = self.run_config.name or f"train_{int(time.time())}"
-        storage = self.run_config.storage_path or os.path.join(
-            os.path.expanduser("~"), "ray_tpu_results"
-        )
-        self.experiment_dir = os.path.join(storage, name)
+        from ray_tpu.train._storage import is_remote_uri
+
+        self._remote_storage = is_remote_uri(self.run_config.storage_path)
+        if self._remote_storage:
+            # URI storage (mock://, s3://, ...): checkpoints upload from the
+            # workers' nodes; the driver only tracks URIs (no shared FS).
+            self.experiment_dir = (
+                self.run_config.storage_path.rstrip("/") + "/" + name
+            )
+        else:
+            storage = self.run_config.storage_path or os.path.join(
+                os.path.expanduser("~"), "ray_tpu_results"
+            )
+            self.experiment_dir = os.path.join(storage, name)
 
     # ------------------------------------------------------------ backend hooks
 
@@ -115,7 +125,8 @@ class DataParallelTrainer:
         )
 
     def _fit_direct(self, report_callback=None) -> Result:
-        os.makedirs(self.experiment_dir, exist_ok=True)
+        if not self._remote_storage:
+            os.makedirs(self.experiment_dir, exist_ok=True)
         failure_config = self.run_config.failure_config or FailureConfig()
         ckpt_config = self.run_config.checkpoint_config or CheckpointConfig()
         retries_left = failure_config.max_failures
@@ -203,10 +214,18 @@ class DataParallelTrainer:
         result_checkpoint: Optional[Checkpoint] = None
         # Continue numbering after any checkpoints a previous (crashed)
         # attempt persisted, so restarts never overwrite newer state.
-        existing = [
-            d for d in os.listdir(self.experiment_dir)
-            if d.startswith("checkpoint_")
-        ] if os.path.isdir(self.experiment_dir) else []
+        if self._remote_storage:
+            from ray_tpu.train._storage import get_storage
+
+            existing = [
+                d for d in get_storage(self.experiment_dir).list_dirs()
+                if d.startswith("checkpoint_")
+            ]
+        else:
+            existing = [
+                d for d in os.listdir(self.experiment_dir)
+                if d.startswith("checkpoint_")
+            ] if os.path.isdir(self.experiment_dir) else []
         ckpt_index = (
             max(int(d.split("_")[-1]) for d in existing) + 1 if existing else 0
         )
@@ -228,16 +247,23 @@ class DataParallelTrainer:
                 lead = reports[min(reports)]["metrics"]
                 last_metrics = lead
                 metrics_history.append(lead)
-                ckpt_path = next(
-                    (r["checkpoint_path"] for r in reports.values()
-                     if "checkpoint_path" in r), None,
+                ckpt_worker, ckpt_path = next(
+                    ((i, r["checkpoint_path"]) for i, r in reports.items()
+                     if "checkpoint_path" in r), (None, None),
                 )
                 if ckpt_path:
-                    dest = os.path.join(
-                        self.experiment_dir, f"checkpoint_{ckpt_index:06d}"
-                    )
+                    rel = f"checkpoint_{ckpt_index:06d}"
                     ckpt_index += 1
-                    shutil.copytree(ckpt_path, dest, dirs_exist_ok=True)
+                    if self._remote_storage:
+                        # the reporting worker uploads from ITS node — no
+                        # shared filesystem assumed
+                        dest = group.execute_single(
+                            ckpt_worker, "upload_checkpoint",
+                            ckpt_path, self.experiment_dir, rel,
+                        )
+                    else:
+                        dest = os.path.join(self.experiment_dir, rel)
+                        shutil.copytree(ckpt_path, dest, dirs_exist_ok=True)
                     attr = ckpt_config.checkpoint_score_attribute
                     score = lead.get(attr, 0.0) if attr else None
                     saved.append((score, dest))
@@ -255,7 +281,14 @@ class DataParallelTrainer:
                         else:
                             worst = 0  # FIFO
                         _, drop = saved.pop(worst)
-                        shutil.rmtree(drop, ignore_errors=True)
+                        if self._remote_storage:
+                            from ray_tpu.train._storage import get_storage
+
+                            get_storage(self.experiment_dir).delete_dir(
+                                drop.rsplit("/", 1)[-1]
+                            )
+                        else:
+                            shutil.rmtree(drop, ignore_errors=True)
                         if result_checkpoint.path == drop:
                             result_checkpoint = Checkpoint(saved[-1][1])
                 if report_callback is not None:
@@ -276,6 +309,16 @@ class DataParallelTrainer:
         )
 
     def _latest_persisted_checkpoint(self) -> Optional[Checkpoint]:
+        if self._remote_storage:
+            from ray_tpu.train._storage import get_storage
+
+            storage = get_storage(self.experiment_dir)
+            ckpts = sorted(
+                d for d in storage.list_dirs() if d.startswith("checkpoint_")
+            )
+            if not ckpts:
+                return self._resume_checkpoint
+            return Checkpoint(storage.uri_of(ckpts[-1]))
         if not os.path.isdir(self.experiment_dir):
             return None
         ckpts = sorted(

@@ -139,6 +139,16 @@ class _TrainWorker:
             session.consumed.set()
         return True
 
+    def upload_checkpoint(self, local_path: str, experiment_uri: str,
+                          rel: str) -> str:
+        """Upload this worker's checkpoint dir into experiment storage from
+        the worker's own node (reference: StorageContext uploads happen
+        worker-side, train/_internal/storage.py:352 — the driver never
+        touches worker-local paths)."""
+        from ray_tpu.train._storage import get_storage
+
+        return get_storage(experiment_uri).upload_dir(local_path, rel)
+
     def finish(self):
         shutdown_session()
         return True

@@ -158,6 +158,13 @@ class TuneController:
             trial.actor = None
 
     def _persist_checkpoint(self, trial: Trial, worker_path: str) -> str:
+        from ray_tpu.train._storage import is_remote_uri
+
+        if is_remote_uri(worker_path):
+            # already durable in URI storage (the trainer's workers uploaded
+            # it); record the URI instead of copying by path
+            trial.latest_checkpoint = worker_path
+            return worker_path
         dest = os.path.join(trial.local_dir,
                             f"checkpoint_{trial._ckpt_index:06d}")
         trial._ckpt_index += 1

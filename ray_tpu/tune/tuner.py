@@ -105,9 +105,17 @@ class Tuner:
                                       {"CPU": 1})
             self._param_space = dict(param_space or {})
         name = self._run_config.name or f"tune_{int(time.time())}"
+        from ray_tpu.train._storage import is_remote_uri
+
         storage = self._run_config.storage_path or os.path.join(
             os.path.expanduser("~"), "ray_tpu_results"
         )
+        if is_remote_uri(storage):
+            # URI storage is for checkpoints (uploaded worker-side by the
+            # inner trainer); the tuner's own trial-state bookkeeping is
+            # driver-local state and stays on the driver's disk.
+            storage = os.path.join(os.path.expanduser("~"),
+                                   "ray_tpu_results")
         self.experiment_dir = os.path.join(storage, name)
 
     # ------------------------------------------------------------------ fit

@@ -1,0 +1,76 @@
+"""DQN tests (reference: rllib/algorithms/dqn tests + tuned_examples
+threshold runs)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DQNConfig
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+
+
+@pytest.fixture(scope="module")
+def rl_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_numpy_q_forward_matches_flax():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import QModule, numpy_q_forward
+
+    mod = QModule(num_actions=3, hidden=(16, 16))
+    params = mod.init_params(obs_dim=4, seed=0)
+    obs = np.random.default_rng(0).normal(size=(7, 4)).astype(np.float32)
+    q_j = mod.apply({"params": params}, jnp.asarray(obs))
+    q_n = numpy_q_forward(jax.tree.map(np.asarray, params), obs)
+    np.testing.assert_allclose(q_n, np.asarray(q_j), atol=1e-5)
+
+
+def test_replay_buffer_wraps():
+    buf = ReplayBuffer(capacity=10, obs_dim=2)
+    mk = lambda n, val: {
+        "obs": np.full((n, 2), val, np.float32),
+        "next_obs": np.full((n, 2), val, np.float32),
+        "actions": np.zeros(n, np.int64),
+        "rewards": np.full(n, val, np.float32),
+        "dones": np.zeros(n, np.float32),
+    }
+    buf.add_batch(mk(6, 1.0))
+    assert buf.size == 6
+    buf.add_batch(mk(6, 2.0))  # wraps: 12 > 10
+    assert buf.size == 10
+    s = buf.sample(np.random.default_rng(0), 32)
+    assert s["obs"].shape == (32, 2)
+    # newest values must be present
+    assert (s["rewards"] == 2.0).any()
+
+
+def test_dqn_cartpole_learns(rl_cluster):
+    """Learning test: CartPole mean return reaches 130 within the budget,
+    with epsilon-greedy CPU rollouts and the double-DQN update jit'd on the
+    8-device mesh."""
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .training(lr=1e-3, train_batch_size=256, updates_per_iteration=64,
+                  target_update_freq=2, epsilon_decay_iters=25,
+                  learning_starts=500)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        best = 0.0
+        for _ in range(80):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 130:
+                break
+        assert best >= 130, f"DQN failed to learn CartPole: best={best:.1f}"
+    finally:
+        algo.stop()

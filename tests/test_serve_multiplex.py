@@ -1,0 +1,83 @@
+"""Model multiplexing tests (reference: python/ray/serve/tests/
+test_multiplex.py — LRU model cache per replica, model-id routing)."""
+
+import asyncio
+
+import pytest
+
+
+@pytest.fixture
+def serve_cluster():
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8)
+    yield serve
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_model_cache_lru_eviction():
+    """Unit: the LRU cache loads once per id and evicts beyond the cap."""
+    from ray_tpu.serve.multiplex import multiplexed
+
+    loads = []
+
+    @multiplexed(max_num_models_per_replica=2)
+    async def get_model(model_id):
+        loads.append(model_id)
+        return f"model-{model_id}"
+
+    async def run():
+        assert await get_model("a") == "model-a"
+        assert await get_model("b") == "model-b"
+        assert await get_model("a") == "model-a"  # cached
+        assert loads == ["a", "b"]
+        await get_model("c")  # evicts b (LRU)
+        assert set(get_model._serve_model_cache.loaded_ids()) == {"a", "c"}
+        await get_model("b")  # reload
+        assert loads == ["a", "b", "c", "b"]
+
+    asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_multiplexed_deployment(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class ModelServer:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id, "weights": len(model_id)}
+
+        async def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return {"model": model["id"], "out": x * model["weights"]}
+
+    handle = serve.run(ModelServer.bind(), name="mux")
+    # same model id must hit the same replica (affinity) and load once
+    for _ in range(4):
+        r = handle.options(multiplexed_model_id="abc").remote(2).result(
+            timeout=30)
+        assert r == {"model": "abc", "out": 6}
+    r = handle.options(multiplexed_model_id="zz").remote(5).result(timeout=30)
+    assert r == {"model": "zz", "out": 10}
+
+
+def test_get_multiplexed_model_id_in_sync_method(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    class Sync:
+        def __call__(self, _):
+            return serve.get_multiplexed_model_id()
+
+    handle = serve.run(Sync.bind(), name="sync_mux")
+    assert handle.options(
+        multiplexed_model_id="m7").remote(0).result(timeout=30) == "m7"
+    assert handle.remote(0).result(timeout=30) == ""

@@ -1,0 +1,130 @@
+"""Checkpoint-storage tests (reference: train/_internal/storage.py:352 —
+URI-addressed persistence; the mock:// scheme simulates S3/GCS with a
+detached actor so the no-shared-FS path is proven without a cloud)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._storage import get_storage, is_remote_uri
+
+
+@pytest.fixture(scope="module")
+def storage_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_is_remote_uri():
+    assert not is_remote_uri("/tmp/x")
+    assert not is_remote_uri("file:///tmp/x")
+    assert not is_remote_uri(None)
+    assert is_remote_uri("mock://bucket/pre")
+    assert is_remote_uri("s3://bucket/pre")
+
+
+def test_mock_storage_roundtrip(storage_cluster, tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("alpha")
+    (src / "sub" / "b.bin").write_bytes(b"\x00\x01")
+
+    st = get_storage("mock://bucket/exp1")
+    uri = st.upload_dir(str(src), "checkpoint_000000")
+    assert uri == "mock://bucket/exp1/checkpoint_000000"
+    assert st.list_dirs() == ["checkpoint_000000"]
+
+    dest = tmp_path / "dest"
+    st.download_dir("checkpoint_000000", str(dest))
+    assert (dest / "a.txt").read_text() == "alpha"
+    assert (dest / "sub" / "b.bin").read_bytes() == b"\x00\x01"
+
+    st.delete_dir("checkpoint_000000")
+    assert st.list_dirs() == []
+
+
+def test_checkpoint_from_uri(storage_cluster, tmp_path):
+    src = tmp_path / "ck"
+    src.mkdir()
+    (src / "w.npy").write_bytes(b"npy!")
+    st = get_storage("mock://bucket/exp2")
+    uri = st.upload_dir(str(src), "checkpoint_000001")
+
+    ckpt = Checkpoint.from_uri(uri)
+    assert ckpt.uri == uri
+    with ckpt.as_directory() as d:
+        assert open(os.path.join(d, "w.npy"), "rb").read() == b"npy!"
+
+
+def test_trainer_with_remote_storage(storage_cluster, tmp_path):
+    """End-to-end: JaxTrainer persists checkpoints to mock:// storage via
+    worker-side uploads; result checkpoint is a URI; resume works."""
+
+    def loop(config):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        start = 0
+        ck = train.get_checkpoint()
+        if ck is not None:
+            with ck.as_directory() as d:
+                start = int(open(_os.path.join(d, "step.txt")).read())
+        for step in range(start, start + 3):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(step + 1))
+            train.report({"step": step + 1},
+                         checkpoint=Checkpoint.from_directory(d))
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(
+            name="remote_exp",
+            storage_path="mock://bucket/results",
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.metrics["step"] == 3
+    assert result.checkpoint is not None
+    assert result.checkpoint.uri.startswith("mock://bucket/results/remote_exp")
+    with result.checkpoint.as_directory() as d:
+        assert open(os.path.join(d, "step.txt")).read() == "3"
+    # retention: only 2 checkpoints remain in the bucket (fit() runs as a
+    # 1-trial Tune run, which roots the trainer under worker_of_<trial>)
+    st = get_storage("mock://bucket/results/remote_exp")
+    subdirs = st.list_dirs()
+    ckpt_dirs = [d for d in subdirs if d.startswith("checkpoint_")]
+    if not ckpt_dirs:
+        inner = next(d for d in subdirs if d.startswith("worker_of"))
+        ckpt_dirs = [
+            d for d in get_storage(st.uri_of(inner)).list_dirs()
+            if d.startswith("checkpoint_")
+        ]
+    assert len(ckpt_dirs) == 2
+
+    # resume from the persisted URI checkpoint
+    trainer2 = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="remote_exp2",
+                             storage_path="mock://bucket/results"),
+        resume_from_checkpoint=result.checkpoint,
+    )
+    r2 = trainer2.fit()
+    assert r2.metrics["step"] == 6

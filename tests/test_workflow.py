@@ -1,0 +1,115 @@
+"""Durable-workflow tests (reference: python/ray/workflow/tests/ —
+test_basic_workflows / checkpoint+resume semantics)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag.node import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module")
+def wf_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def wf_storage(tmp_path):
+    workflow.init(storage=str(tmp_path))
+    yield str(tmp_path)
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def mul(a, b):
+    return a * b
+
+
+def test_basic_dag(wf_cluster, wf_storage):
+    dag = add.bind(1, mul.bind(2, 3))
+    assert workflow.run(dag, workflow_id="w1") == 7
+    assert workflow.get_status("w1") == workflow.SUCCESSFUL
+    assert workflow.get_output("w1") == 7
+
+
+def test_input_node(wf_cluster, wf_storage):
+    with InputNode() as inp:
+        dag = add.bind(inp, 10)
+    assert workflow.run(dag, workflow_id="w2", input_value=5) == 15
+
+
+def test_multi_output(wf_cluster, wf_storage):
+    dag = MultiOutputNode([add.bind(1, 1), mul.bind(3, 3)])
+    assert workflow.run(dag, workflow_id="w3") == [2, 9]
+
+
+def test_checkpoints_skip_on_resume(wf_cluster, wf_storage, tmp_path):
+    marker = tmp_path / "count.txt"
+
+    @ray_tpu.remote
+    def effect(x):
+        with open(marker, "a") as f:
+            f.write("x")
+        return x * 2
+
+    dag = add.bind(effect.bind(5), 1)
+    assert workflow.run(dag, workflow_id="w4") == 11
+    assert marker.read_text() == "x"
+    # resume: the effect step is checkpointed, so it must NOT run again
+    assert workflow.resume("w4") == 11
+    assert marker.read_text() == "x"
+
+
+def test_resume_after_failure(wf_cluster, wf_storage, tmp_path):
+    flag = tmp_path / "fail.flag"
+    flag.write_text("1")
+
+    @ray_tpu.remote
+    def stage1():
+        return 41
+
+    @ray_tpu.remote
+    def flaky(x):
+        if os.path.exists(flag):
+            raise RuntimeError("injected failure")
+        return x + 1
+
+    dag = flaky.bind(stage1.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w5")
+    assert workflow.get_status("w5") == workflow.FAILED
+    flag.unlink()
+    # stage1's checkpoint survives; only flaky reruns
+    assert workflow.resume("w5") == 42
+    assert workflow.get_status("w5") == workflow.SUCCESSFUL
+
+
+def test_continuation(wf_cluster, wf_storage):
+    @ray_tpu.remote
+    def fib(n):
+        if n <= 1:
+            return n
+        return add.bind(fib.bind(n - 1), fib.bind(n - 2))
+
+    assert workflow.run(fib.bind(7), workflow_id="w6") == 13
+
+
+def test_list_and_delete(wf_cluster, wf_storage):
+    workflow.run(add.bind(1, 2), workflow_id="wa")
+    workflow.run(add.bind(3, 4), workflow_id="wb")
+    ids = {w["workflow_id"] for w in workflow.list_all()}
+    assert {"wa", "wb"} <= ids
+    ok = {w["workflow_id"]
+          for w in workflow.list_all(status_filter=workflow.SUCCESSFUL)}
+    assert {"wa", "wb"} <= ok
+    workflow.delete("wa")
+    ids = {w["workflow_id"] for w in workflow.list_all()}
+    assert "wa" not in ids
