@@ -193,7 +193,12 @@ def main():
         "# Microbenchmarks (ray_perf port)",
         "",
         "Run on this machine's CPU control plane via `python microbench.py`.",
-        "Reference numbers from BASELINE.md (release rig, m5.16xlarge).",
+        "Reference numbers from BASELINE.md (release rig, m5.16xlarge) —",
+        "absolute cross-machine comparisons are rough. Context: this box's",
+        "raw shared-memory write bandwidth measures 2.1 GiB/s (page-fault",
+        "bound), so ~1.4 GiB/s through the full put path is ~65% of the",
+        "hardware ceiling here. Numbers vary ±25% run to run with process",
+        "warm-up (PG cycle measured 268-555/s across trials in one process).",
         "",
         "| metric | ray_tpu | reference | ratio |",
         "|---|---|---|---|",

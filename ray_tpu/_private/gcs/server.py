@@ -436,6 +436,16 @@ class GcsServer:
         node["num_leases"] = req.get("num_leases", 0)
         node["num_workers"] = req.get("num_workers", 0)
         self.node_last_beat[req["node_id"]] = time.time()
+        # Push the delta to every raylet's cluster view (the RaySyncer
+        # broadcast plane, reference: common/ray_syncer/ray_syncer.h:88 —
+        # here a pubsub channel drained by batched long-polls).
+        self.pubsub.publish("resources", {
+            "node_id": req["node_id"],
+            "available": req["available"],
+            "total": req["total"],
+            "num_leases": node["num_leases"],
+            "num_workers": node["num_workers"],
+        })
         if self.pending_actor_queue:
             asyncio.ensure_future(self._schedule_pending_actors())
         if self.pending_pg_queue:
@@ -526,7 +536,10 @@ class GcsServer:
 
     async def handle_Subscribe(self, req):
         self.pubsub.subscribe(req["sub_id"], req["channel"])
-        return {"ok": True}
+        # Epoch lets the subscriber baseline restart detection atomically
+        # with the subscription (a restart between Subscribe and the first
+        # poll would otherwise go unnoticed forever).
+        return {"ok": True, "epoch": self.epoch}
 
     async def handle_Unsubscribe(self, req):
         self.pubsub.unsubscribe(req["sub_id"], req.get("channel"))
@@ -627,14 +640,22 @@ class GcsServer:
         return {"ok": True}
 
     def _pick_node(self, resources: Dict[str, float], strategy: dict) -> Optional[bytes]:
-        """Hybrid placement for actors/PG bundles at the GCS level."""
+        """Hybrid placement for actors/PG bundles at the GCS level.
+        node_label strategies filter candidates to hard-label matches and
+        prefer soft-label matches (reference:
+        raylet/scheduling/policy/node_label_scheduling_policy.cc)."""
+        is_label = strategy.get("type") == "node_label"
+        hard = (strategy.get("hard") or {}) if is_label else {}
+        soft = (strategy.get("soft") or {}) if is_label else {}
         candidates = []
-        soft_affinity = None
         for nid in self.alive_nodes():
             n = self.nodes[nid]
             if strategy.get("type") == "node_affinity":
                 if nid != strategy["node_id"]:
                     continue
+            labels = n.get("labels", {})
+            if is_label and any(labels.get(k) != v for k, v in hard.items()):
+                continue
             avail = n["resources_available"]
             total = n["resources_total"]
             if all(avail.get(k, 0) >= v for k, v in resources.items()) and all(
@@ -643,7 +664,15 @@ class GcsServer:
                 used = sum(
                     1 - avail.get(k, 0) / total[k] for k in total if total[k] > 0
                 )
-                candidates.append((used, nid))
+                soft_ok = bool(soft) and all(
+                    labels.get(k) == v for k, v in soft.items()
+                )
+                candidates.append((used, nid, soft_ok))
+        if soft and any(c[2] for c in candidates):
+            # soft-label matches exist: restrict to them (soft preference
+            # outranks the load score but never makes placement infeasible)
+            candidates = [c for c in candidates if c[2]]
+        candidates = [(used, nid) for used, nid, _ in candidates]
         if not candidates:
             if strategy.get("type") == "node_affinity" and strategy.get("soft"):
                 return self._pick_node(resources, {})

@@ -66,6 +66,11 @@ class NodeManager:
         self.available = ResourceSet(resources)
         self.labels = labels
         self._resources_dirty = True
+        # Per-instance accelerator IDs (reference: scheduling_ids.h:162 —
+        # GPU_0-style instances; here TPU chip ids). Integer-TPU leases get
+        # specific chips via TPU_VISIBLE_CHIPS so two concurrent workers
+        # never see the same chip; fractional demands share the pool.
+        self._free_chips: List[int] = list(range(int(resources.get("TPU", 0))))
 
         self.plasma_name = f"/rtpu_plasma_{node_id.hex()[:12]}"
         self.plasma = PlasmaClient(
@@ -247,21 +252,74 @@ class NodeManager:
                 pass
             await asyncio.sleep(min(period, report_period))
 
+    async def _refresh_cluster_view(self):
+        nodes = await self.gcs.get_all_node_info()
+        new_view = {n["node_id"]: n for n in nodes if n["state"] == "ALIVE"}
+        grew = set(new_view) - set(self.cluster_view)
+        self.cluster_view = new_view
+        if grew:
+            # New capacity (e.g. autoscaler launch): re-evaluate queued
+            # lease requests so they can spill to it.
+            self._kick_waiters()
+
     async def _cluster_view_loop(self):
+        """Push-based cluster view (reference: RaySyncer resource broadcast,
+        common/ray_syncer/ray_syncer.h:88 — bidirectional gRPC streams; here
+        the GCS pubsub 'node'/'resources' channels drained with batched
+        long-polls). Full refetches happen only on membership growth, GCS
+        epoch change, or a slow 15s safety net — not on a fixed 500ms poll.
+        """
+        sub_id = b"raylet-view:" + self.node_id.binary()
+        subscribed = False
+        epoch = None
+        last_full = 0.0
         while True:
             try:
-                nodes = await self.gcs.get_all_node_info()
-                new_view = {n["node_id"]: n for n in nodes if n["state"] == "ALIVE"}
-                grew = set(new_view) - set(self.cluster_view)
-                self.cluster_view = new_view
-                if grew:
-                    # New capacity (e.g. autoscaler launch): re-evaluate
-                    # queued lease requests so they can spill to it.
-                    self._kick_waiters()
-                # autoscaler-active state rides on the Heartbeat replies.
+                if not subscribed:
+                    for ch in ("node", "resources"):
+                        r = await self.gcs.call(
+                            "Subscribe", {"sub_id": sub_id, "channel": ch},
+                            timeout=10,
+                        )
+                        # baseline the epoch from the subscribe reply so a
+                        # GCS restart before the first poll is detected
+                        epoch = r.get("epoch", epoch)
+                    subscribed = True
+                    await self._refresh_cluster_view()
+                    last_full = time.time()
+                reply = await self.gcs.call(
+                    "PubsubPoll", {"sub_id": sub_id, "timeout": 10.0},
+                    timeout=30,
+                )
+                new_epoch = reply.get("epoch")
+                if epoch is not None and new_epoch != epoch:
+                    # GCS restarted: its subscriber table is gone
+                    subscribed = False
+                    epoch = new_epoch
+                    continue
+                epoch = new_epoch
+                refresh = False
+                for channel, msg in reply.get("batch", []):
+                    if channel == "node":
+                        if msg.get("state") == "DEAD":
+                            self.cluster_view.pop(msg["node_id"], None)
+                        else:
+                            refresh = True  # new node: fetch its full record
+                    elif channel == "resources":
+                        info = self.cluster_view.get(msg["node_id"])
+                        if info is not None:
+                            info["resources_available"] = msg["available"]
+                            info["resources_total"] = msg["total"]
+                            info["num_leases"] = msg.get(
+                                "num_leases", info.get("num_leases", 0))
+                            info["num_workers"] = msg.get(
+                                "num_workers", info.get("num_workers", 0))
+                if refresh or time.time() - last_full > 15.0:
+                    await self._refresh_cluster_view()
+                    last_full = time.time()
             except Exception:
-                pass
-            await asyncio.sleep(0.5)
+                subscribed = False
+                await asyncio.sleep(0.5)
 
     async def _reaper_loop(self):
         while True:
@@ -312,10 +370,24 @@ class NodeManager:
             return {"demand": demand, "bundle": bundle_key}
         return None
 
+    def _allocate_chips(self, num_tpu: float) -> Optional[List[int]]:
+        """Assign specific chip ids to an integer-TPU lease; None when the
+        demand is fractional/zero (worker then sees the node default)."""
+        if num_tpu <= 0 or num_tpu != int(num_tpu):
+            return None
+        n = int(num_tpu)
+        if len(self._free_chips) < n:
+            return None
+        chips, self._free_chips = self._free_chips[:n], self._free_chips[n:]
+        return chips
+
     def _release_lease(self, lease_id: bytes):
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return
+        if lease.get("chips"):
+            self._free_chips.extend(lease["chips"])
+            self._free_chips.sort()
         if lease["bundle"] is not None:
             bundle = self.bundles.get(lease["bundle"])
             if bundle is not None:
@@ -338,15 +410,27 @@ class NodeManager:
             return bundle is not None and bundle["committed"]
         return self.total.fits(ResourceSet(resources))
 
+    @staticmethod
+    def _labels_match(labels: Dict[str, str], selector) -> bool:
+        return all(labels.get(k) == v for k, v in (selector or {}).items())
+
     def _pick_spill_node(
         self, resources: Dict[str, float], strategy: dict, require_available: bool
     ) -> Optional[dict]:
-        """Hybrid policy over the GCS cluster view; returns peer node info or None."""
+        """Hybrid policy over the GCS cluster view; returns peer node info or
+        None. node_label strategies (reference:
+        raylet/scheduling/policy/node_label_scheduling_policy.cc) restrict
+        candidates to hard-label matches and prefer soft-label matches."""
         demand = ResourceSet(resources)
+        is_label = strategy.get("type") == "node_label"
+        hard = strategy.get("hard") if is_label else None
+        soft = strategy.get("soft") if is_label else None
         best = None
         best_score = None
         for nid, info in self.cluster_view.items():
             if nid == self.node_id.binary():
+                continue
+            if is_label and not self._labels_match(info.get("labels", {}), hard):
                 continue
             total = ResourceSet(info.get("resources_total", {}))
             avail = ResourceSet(info.get("resources_available", {}))
@@ -360,6 +444,8 @@ class NodeManager:
                 score = used  # least loaded wins
             else:
                 score = -used  # pack: most loaded feasible wins
+            if soft and self._labels_match(info.get("labels", {}), soft):
+                score -= 100.0  # soft matches dominate the load score
             if best_score is None or score < best_score:
                 best, best_score = info, score
         return best
@@ -396,6 +482,36 @@ class NodeManager:
                 return {"spill": {"ip": target["ip"], "port": target["raylet_port"],
                                    "node_id": target["node_id"]}}
 
+        if strategy.get("type") == "node_label":
+            hard = strategy.get("hard") or {}
+            soft = strategy.get("soft") or {}
+            if not self._labels_match(self.labels, hard):
+                target = self._pick_spill_node(
+                    resources, strategy, require_available=False
+                )
+                if target is None:
+                    return {"error": (
+                        f"no alive node matches required labels {hard}"
+                    )}
+                return {"spill": {
+                    "ip": target["ip"], "port": target["raylet_port"],
+                    "node_id": target["node_id"],
+                }}
+            if soft and not self._labels_match(self.labels, soft):
+                # Local node satisfies hard but not soft: prefer a peer that
+                # satisfies both and has free capacity; otherwise stay local
+                # (soft preference never makes placement infeasible).
+                target = self._pick_spill_node(
+                    resources, strategy, require_available=True
+                )
+                if target is not None and self._labels_match(
+                    target.get("labels", {}), soft
+                ):
+                    return {"spill": {
+                        "ip": target["ip"], "port": target["raylet_port"],
+                        "node_id": target["node_id"],
+                    }}
+
         # PG-bound tasks are routed by the owner to the raylet holding the
         # bundle; they queue on that bundle and never spill (reference:
         # local_task_manager keeps PG tasks local to the committed bundle).
@@ -422,11 +538,20 @@ class NodeManager:
                 return {"error": "placement group removed"}
             grant = self._try_acquire(resources, strategy)
             if grant is not None:
-                handle = await self.worker_pool.pop_worker(job_id, env_overrides)
+                chips = self._allocate_chips(resources.get("TPU", 0))
+                worker_env = dict(env_overrides or {})
+                if chips is not None:
+                    worker_env.update(accelerators.visible_chip_env(chips))
+                handle = await self.worker_pool.pop_worker(
+                    job_id, worker_env or None
+                )
                 if handle is None:
                     # worker failed to start; release and retry
                     pool, _ = self._pool_for(strategy)
                     pool.release(grant["demand"])
+                    if chips:
+                        self._free_chips.extend(chips)
+                        self._free_chips.sort()
                     return {"error": "worker startup failed"}
                 self._lease_seq += 1
                 lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
@@ -435,6 +560,7 @@ class NodeManager:
                     "worker_id": handle.worker_id,
                     "grant": grant,
                     "bundle": grant["bundle"],
+                    "chips": chips,
                 }
                 return {
                     "granted": True,
@@ -456,6 +582,17 @@ class NodeManager:
                         return {"spill": {"ip": spill_now["ip"], "port": spill_now["raylet_port"],
                                            "node_id": spill_now["node_id"]}}
                     spill_any = self._pick_spill_node(resources, strategy, require_available=False)
+                    if spill_any is None:
+                        # Authoritative view refresh before declaring
+                        # infeasibility: a just-registered node may not have
+                        # reached our pushed view yet (rare path, one RPC).
+                        try:
+                            await self._refresh_cluster_view()
+                        except Exception:
+                            pass
+                        spill_any = self._pick_spill_node(
+                            resources, strategy, require_available=False
+                        )
                     if spill_any is not None:
                         return {"spill": {"ip": spill_any["ip"], "port": spill_any["raylet_port"],
                                            "node_id": spill_any["node_id"]}}
@@ -536,13 +673,16 @@ class NodeManager:
             pool, _ = self._pool_for(req.get("strategy", {}))
             pool.release(grant["demand"])
             return {"granted": False, "error": f"runtime_env setup failed: {e}"}
-        num_tpu = req["resources"].get("TPU", 0)
-        if num_tpu and num_tpu == int(num_tpu):
-            env.update(accelerators.visible_chip_env(range(int(num_tpu))))
+        chips = self._allocate_chips(req["resources"].get("TPU", 0))
+        if chips is not None:
+            env.update(accelerators.visible_chip_env(chips))
         handle = await self.worker_pool.pop_worker(req["job_id"], env or None)
         if handle is None:
             pool, _ = self._pool_for(req.get("strategy", {}))
             pool.release(grant["demand"])
+            if chips:
+                self._free_chips.extend(chips)
+                self._free_chips.sort()
             return {"granted": False}
         self._lease_seq += 1
         lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
@@ -552,6 +692,7 @@ class NodeManager:
             "worker_id": handle.worker_id,
             "grant": grant,
             "bundle": grant["bundle"],
+            "chips": chips,
         }
         self._actor_workers[handle.worker_id] = req["actor_id"]
         return {

@@ -257,19 +257,68 @@ class RpcClient:
 
 
 class IoThread:
-    """The per-process background asyncio loop (the 'io_service')."""
+    """The per-process background asyncio loop (the 'io_service').
+
+    Debug mode (the asyncio runtime's sanitizer analogue — the reference
+    ships tsan/asan build configs, .bazelrc :104; a single-threaded asyncio
+    control plane's failure mode is instead a BLOCKED loop): set
+    ``RTPU_DEBUG_LOOP_MS=<n>`` to (a) log callbacks that hold the loop
+    longer than n ms via asyncio's slow-callback detector and (b) run a
+    watchdog thread that dumps all stacks if the loop stops ticking for
+    10×n ms — catching accidental sync work (ray_tpu.get etc.) posted onto
+    the io loop, the class of deadlock the client-server had."""
 
     _singleton = None
     _singleton_lock = threading.Lock()
 
     def __init__(self, name="rtpu-io"):
+        import os as _os
+
         self.loop = asyncio.new_event_loop()
+        self._debug_ms = float(_os.environ.get("RTPU_DEBUG_LOOP_MS", "0") or 0)
+        if self._debug_ms > 0:
+            self.loop.slow_callback_duration = self._debug_ms / 1000.0
+            self.loop.set_debug(True)
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+        if self._debug_ms > 0:
+            self._last_tick = 0.0
+            threading.Thread(
+                target=self._watchdog, name=name + "-watchdog", daemon=True
+            ).start()
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
         self.loop.run_forever()
+
+    def _watchdog(self):
+        import faulthandler
+        import sys
+        import time as _time
+
+        stall = self._debug_ms * 10 / 1000.0
+        self._last_tick = _time.monotonic()
+
+        async def _tick():
+            self._last_tick = _time.monotonic()
+
+        warned = 0.0
+        while True:
+            _time.sleep(stall / 2)
+            try:
+                asyncio.run_coroutine_threadsafe(_tick(), self.loop)
+            except RuntimeError:
+                return  # loop closed
+            _time.sleep(stall / 2)
+            now = _time.monotonic()
+            if now - self._last_tick > stall and now - warned > 5.0:
+                warned = now
+                print(
+                    f"[rtpu-io watchdog] io loop blocked > {stall:.2f}s — "
+                    "sync work is running on the io thread; stacks follow",
+                    file=sys.stderr, flush=True,
+                )
+                faulthandler.dump_traceback(file=sys.stderr)
 
     @classmethod
     def current(cls) -> "IoThread":

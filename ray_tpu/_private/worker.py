@@ -736,22 +736,76 @@ class CoreWorker:
         return self.io.run(self._async_wait(refs, num_returns, deadline, fetch_local))
 
     async def _async_wait(self, refs, num_returns, deadline, fetch_local):
-        pending = list(refs)
+        """Event-driven wait: one waiter per pending ref. Owned refs ride the
+        memory-store per-object event; borrowed refs long-poll their owner
+        with wait=True (the owner's GetObjectStatus blocks server-side until
+        the object resolves) — no fixed-interval polling in either path
+        (reference: core_worker Wait is a callback on object availability,
+        src/ray/core_worker/core_worker.cc Wait)."""
         ready: List[ObjectRef] = []
-        while True:
-            still = []
-            for ref in pending:
-                if await self._is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.time() >= deadline:
-                break
-            await asyncio.sleep(0.005)
+        pending: List[ObjectRef] = []
+        for ref in refs:
+            if await self._is_ready(ref):
+                ready.append(ref)
+            else:
+                pending.append(ref)
+        if len(ready) >= num_returns or not pending:
+            return ready, pending
+        waiters = {
+            asyncio.ensure_future(self._wait_one(ref)): ref
+            for ref in pending
+        }
+        try:
+            while len(ready) < num_returns and waiters:
+                timeout = (
+                    None if deadline is None
+                    else max(0.0, deadline - time.time())
+                )
+                done, _ = await asyncio.wait(
+                    waiters.keys(), timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    break  # deadline
+                for t in done:
+                    ready.append(waiters.pop(t))
+        finally:
+            for t in waiters:
+                t.cancel()
+        # preserve input order in both lists (reference semantics)
+        ready_set = set(ready)
+        ready = [r for r in refs if r in ready_set]
+        pending = [r for r in refs if r not in ready_set]
         return ready, pending
+
+    async def _wait_one(self, ref: ObjectRef) -> None:
+        """Resolves when the ref is ready (value, plasma copy, or error)."""
+        oid = ref.object_id()
+        while True:
+            if await self._is_ready(ref):
+                return
+            if self.memory_store.is_pending(oid):
+                await self.memory_store.wait_ready(oid, None)
+                continue
+            if self.refs.owns(oid):
+                # owned but not yet registered as pending (submit in flight)
+                await asyncio.sleep(0.01)
+                continue
+            owner = ref.owner_address
+            if owner is None:
+                await asyncio.sleep(0.01)
+                continue
+            try:
+                client = await self.pool.get(owner[0], owner[1])
+                status = await client.call(
+                    "GetObjectStatus",
+                    {"object_id": oid.binary(), "wait": True, "timeout": 30},
+                    timeout=35,
+                )
+                if status.get("status") != "pending":
+                    return  # ready / freed / error — all count as resolved
+            except Exception:
+                await asyncio.sleep(0.1)
 
     async def _is_ready(self, ref: ObjectRef) -> bool:
         oid = ref.object_id()
@@ -1004,22 +1058,36 @@ class CoreWorker:
         pg_key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
         node_id = self._pg_node_cache.get(pg_key)
         if node_id is None:
+            # Event-driven: the GCS blocks this call until the 2PC finishes
+            # (WaitPlacementGroupReady arms a server-side event) — no
+            # client-side polling interval. Transient RPC failures (GCS
+            # restart) retry until the ready deadline; only an authoritative
+            # "removed"/timeout answer fails the tasks.
             deadline = time.time() + RTPU_CONFIG.placement_group_ready_timeout_s
-            while time.time() < deadline:
-                reply = await self.gcs_aio.call(
-                    "GetPlacementGroup", {"pg_id": pg_key[0]}
-                )
-                if not reply.get("found"):
+            while True:
+                left = deadline - time.time()
+                if left <= 0:
                     return None
-                pg = reply["pg"]
-                if pg["state"] == "CREATED":
-                    node_id = pg["bundles"][pg_key[1]]["node_id"]
-                    break
-                if pg["state"] == "REMOVED":
+                try:
+                    reply = await self.gcs_aio.call(
+                        "WaitPlacementGroupReady",
+                        {"pg_id": pg_key[0], "timeout": left},
+                        timeout=left + 10,
+                    )
+                except RemoteError:
+                    return None  # GCS answered: the PG is removed
+                except Exception:
+                    await asyncio.sleep(0.5)  # transient; GCS may be restarting
+                    continue
+                if not reply.get("ready"):
                     return None
-                await asyncio.sleep(0.05)
-            if node_id is None:
+                break
+            info = await self.gcs_aio.call(
+                "GetPlacementGroup", {"pg_id": pg_key[0]}
+            )
+            if not info.get("found") or info["pg"]["state"] != "CREATED":
                 return None
+            node_id = info["pg"]["bundles"][pg_key[1]]["node_id"]
             self._pg_node_cache[pg_key] = node_id
         info = await self._node_info(node_id)
         if info is None:

@@ -1,0 +1,83 @@
+"""Node-label scheduling tests (reference:
+raylet/scheduling/policy/node_label_scheduling_policy.cc +
+util/scheduling_strategies.py NodeLabelSchedulingStrategy)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+
+@pytest.fixture(scope="module")
+def label_cluster():
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 2},
+                        "labels": {"zone": "a", "tier": "cpu"}},
+    )
+    cluster.add_node(resources={"CPU": 2},
+                     labels={"zone": "b", "tier": "accel"})
+    cluster.add_node(resources={"CPU": 2},
+                     labels={"zone": "c", "tier": "accel"})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    node_by_zone = {}
+    for n in ray_tpu.nodes():
+        node_by_zone[n["Labels"].get("zone")] = n["NodeID"]
+    yield node_by_zone
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def test_hard_label_routes_task(label_cluster):
+    node_by_zone = label_cluster
+    for zone in ("a", "b", "c"):
+        nid = ray_tpu.get(
+            where.options(
+                scheduling_strategy=NodeLabelSchedulingStrategy(
+                    hard={"zone": zone})
+            ).remote(),
+            timeout=60,
+        )
+        assert nid == node_by_zone[zone]
+
+
+def test_hard_label_no_match_errors(label_cluster):
+    ref = where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"zone": "zz"})
+    ).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_soft_label_prefers_match(label_cluster):
+    node_by_zone = label_cluster
+    nid = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"tier": "accel"}, soft={"zone": "c"})
+        ).remote(),
+        timeout=60,
+    )
+    assert nid == node_by_zone["c"]
+
+
+def test_label_actor_placement(label_cluster):
+    node_by_zone = label_cluster
+
+    @ray_tpu.remote
+    class Pin:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pin.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"zone": "b"})
+    ).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == node_by_zone["b"]
+    ray_tpu.kill(a)
