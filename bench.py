@@ -19,13 +19,20 @@ import os
 import subprocess
 import sys
 
-WARMUP = 3
-STEPS = 10
+WARMUP = 10
+STEPS = 400
+# Both sides run lax.scan chunks of SCAN steps per dispatch (XLA-idiomatic:
+# "no data-dependent Python control flow inside jit"); the framework reports
+# once per chunk — the standard log-every-N product pattern.
+SCAN = 10
 
 
 def _model_kw(on_tpu: bool):
     if on_tpu:
-        return dict(preset="124m"), 8, 1024
+        # B=16 x T=1024 on GPT-2-124M: the largest batch that fits beside
+        # the optimizer state in one chip's HBM (B=64 OOMs on the fp32
+        # logits). Same workload on both sides of the ratio.
+        return dict(preset="124m"), 16, 1024
     return (
         dict(vocab_size=2048, block_size=256, n_layer=4, n_head=8, n_embd=256,
              dtype="float32", use_flash_attention=False),
@@ -56,9 +63,73 @@ def _batch(vocab_size, B, T):
 # ------------------------------------------------------------ framework phase
 
 
+def _make_control(cfg, B, T):
+    """Raw-jax control: same model/optimizer/step math as TrainStep, donated
+    buffers, scanned in SCAN-step chunks, no framework. Returns a
+    run_chunk() closure; timed chunks INTERLEAVE with the framework's so
+    the shared-TPU tunnel's minute-scale throughput drift (measured 2-3x on
+    identical workloads) cancels out of the ratio."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.gpt2 import GPT2, loss_fn
+
+    model = GPT2(cfg)
+    opt = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(3e-4, b2=0.95, weight_decay=0.1,
+                    mask=lambda p: jax.tree.map(lambda x: x.ndim > 1, p)),
+    )
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 8), jnp.int32))["params"]
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, idx, targets):
+        def loss_of(p):
+            return loss_fn(model.apply({"params": p}, idx), targets)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    def multi(params, opt_state, idx, targets):
+        def body(carry, _):
+            p, o = carry
+            p, o, loss = step(p, o, idx, targets)
+            return (p, o), loss
+
+        (p, o), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=SCAN)
+        return p, o, losses
+
+    multi = jax.jit(multi, donate_argnums=(0, 1))
+
+    b = _batch(cfg.vocab_size, B, T)
+    idx, tgt = jnp.asarray(b["idx"]), jnp.asarray(b["targets"])
+    holder = {"p": params, "o": opt_state}
+
+    def run_chunk():
+        import jax as _jax
+        import time as _time
+
+        t0 = _time.perf_counter()
+        holder["p"], holder["o"], losses = multi(
+            holder["p"], holder["o"], idx, tgt)
+        _jax.block_until_ready(losses)
+        return _time.perf_counter() - t0
+
+    return run_chunk
+
+
 def train_loop(config):
-    """Runs inside the JaxTrainer worker: sharded TrainStep + real report
-    rounds every step (the product path a user would write)."""
+    """Runs inside the JaxTrainer worker: the raw-jax control and the
+    framework path (sharded TrainStep + report round per chunk — the
+    product loop a user writes) alternate timed SCAN-step chunks in one
+    process, so tunnel-throughput drift hits both sides equally."""
     import time
 
     import jax
@@ -69,25 +140,36 @@ def train_loop(config):
 
     cfg = _build_cfg(config["model_kw"])
     B, T = config["B"], config["T"]
+    run_control_chunk = _make_control(cfg, B, T)
+
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
     ts = TrainStep(cfg, mesh)
     state = ts.init(jax.random.PRNGKey(0))
     batch = ts.shard_batch(_batch(cfg.vocab_size, B, T))
-    for _ in range(config["warmup"]):
-        state, m = ts.step(state, batch)
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for i in range(config["steps"]):
-        state, m = ts.step(state, batch)
-        # Per-step report round through the session (driver consumes + acks).
-        # The live loss is NOT materialized mid-run — a raw jax loop wouldn't
-        # sync either; the report itself is the framework overhead we measure.
-        train.report({"step": i})
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+
+    def run_ours_chunk(i):
+        t0 = time.perf_counter()
+        nonlocal state
+        state, m = ts.multi_step(state, batch, SCAN)
+        # Report round through the session (driver drains + acks) — the
+        # framework overhead being measured rides inside the timed chunk.
+        train.report({"chunk": i})
+        jax.block_until_ready(m["loss"])
+        return time.perf_counter() - t0
+
+    warm_chunks = max(1, config["warmup"] // SCAN) + 1
+    for i in range(warm_chunks):
+        run_control_chunk()
+        run_ours_chunk(-1 - i)
+    chunks = config["steps"] // SCAN
+    t_raw = t_ours = 0.0
+    for i in range(chunks):
+        t_raw += run_control_chunk()
+        t_ours += run_ours_chunk(i)
+    tokens = B * T * chunks * SCAN
     train.report({
-        "tokens_per_s": B * T * config["steps"] / dt,
-        "loss": float(m["loss"]),
+        "tokens_per_s": tokens / t_ours,
+        "raw_tokens_per_s": tokens / t_raw,
     })
 
 
@@ -112,7 +194,8 @@ def phase_framework(on_tpu: bool) -> float:
             ),
         )
         result = trainer.fit()
-        return result.metrics["tokens_per_s"]
+        return {"ours": result.metrics["tokens_per_s"],
+                "raw": result.metrics["raw_tokens_per_s"]}
     finally:
         ray_tpu.shutdown()
 
@@ -220,18 +303,27 @@ def main():
         result = fn(on_tpu) if phase != "micro" else fn()
         print(json.dumps({"result": result}))
         return
-    ours = _run_phase("framework")
-    raw = _run_phase("control")
+    # The shared-TPU tunnel's throughput drifts minute to minute (2.4x
+    # spread measured on identical workloads), so control and framework
+    # chunks alternate INSIDE the same worker process per run; the per-run
+    # ratio is drift-free. Report the median-ratio run of 3.
+    runs = [_run_phase("framework") for _ in range(3)]
+    runs_sorted = sorted(runs, key=lambda r: r["ours"] / r["raw"])
+    best = runs_sorted[len(runs_sorted) // 2]  # median ratio run
     try:
         micro = _run_phase("micro")
     except Exception:
         micro = {}
     print(json.dumps({
         "metric": "gpt2_train_tokens_per_s_via_JaxTrainer",
-        "value": round(ours, 1),
+        "value": round(best["ours"], 1),
         "unit": "tokens/s",
-        "vs_baseline": round(ours / raw, 4),
-        "raw_jax_control_tokens_per_s": round(raw, 1),
+        "vs_baseline": round(best["ours"] / best["raw"], 4),
+        "raw_jax_control_tokens_per_s": round(best["raw"], 1),
+        "all_runs": [
+            {"ours": round(r["ours"], 1), "raw": round(r["raw"], 1),
+             "ratio": round(r["ours"] / r["raw"], 4)} for r in runs
+        ],
         "micro": micro,
     }))
 

@@ -127,6 +127,9 @@ class GcsServer:
         self.host = host
         self.session_dir = session_dir
         self.server = RpcServer(host)
+        from ray_tpu._private import schema as _schema
+
+        self.server.set_validator(_schema.make_validator(_schema.GCS_SCHEMAS))
         self.kv = KVStore()
         self.pubsub = PubSub()
         self.pool = ClientPool()  # clients to raylets / workers

@@ -58,6 +58,9 @@ class NodeManager:
         self.session_dir = session_dir
         self.is_head = is_head
         self.server = RpcServer(host)
+        from ray_tpu._private import schema as _schema
+
+        self.server.set_validator(_schema.make_validator(_schema.RAYLET_SCHEMAS))
         gcs_host, gcs_port = gcs_address.rsplit(":", 1)
         self.gcs = GcsAioClient(gcs_host, int(gcs_port))
         self.pool = ClientPool()

@@ -85,6 +85,12 @@ class RpcServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._conns: set = set()
+        self._validator = None
+
+    def set_validator(self, fn):
+        """Optional (method, payload) -> None hook run before dispatch;
+        raise to reject (see _private/schema.py typed wire contracts)."""
+        self._validator = fn
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
@@ -151,6 +157,8 @@ class RpcServer:
             handler = self._handlers.get(method)
             if handler is None:
                 raise RpcError(f"no such method: {method}")
+            if self._validator is not None:
+                self._validator(method, payload)
             result = await handler(payload)
             out = _pack([MSG_RESPONSE, seq, None, result])
         except Exception as e:

@@ -201,6 +201,9 @@ class CoreWorker:
         self.inline_threshold = RTPU_CONFIG.max_direct_call_object_size
 
         self.server = RpcServer(host)
+        from ray_tpu._private import schema as _schema
+
+        self.server.set_validator(_schema.make_validator(_schema.WORKER_SCHEMAS))
         self.pool = ClientPool()
         gcs_host, gcs_port = gcs_address.rsplit(":", 1)
         self.gcs_aio = GcsAioClient(gcs_host, int(gcs_port))

@@ -122,23 +122,12 @@ class DashboardHead:
         return 404, {"error": f"no route {path}"}
 
     def _index_html(self) -> bytes:
-        state = self._state()
-        nodes = state.list_nodes(self.gcs_address)
-        actors = state.list_actors(self.gcs_address)
-        rows = "".join(
-            f"<tr><td>{n['node_id'][:12]}</td><td>{n['state']}</td>"
-            f"<td>{n['node_ip']}</td><td>{n['resources_total']}</td></tr>"
-            for n in nodes
+        """Single-page live dashboard: vanilla JS polling the /api routes
+        (reference: dashboard/client/ — a React app; same information
+        surface, no build step)."""
+        return _INDEX_HTML.replace(
+            b"__GCS__", self.gcs_address.encode()
         )
-        return (
-            "<html><head><title>ray_tpu dashboard</title></head><body>"
-            f"<h2>ray_tpu cluster @ {self.gcs_address}</h2>"
-            f"<p>{len(nodes)} nodes, {len(actors)} actors. "
-            "JSON API under <code>/api/*</code>.</p>"
-            "<table border=1 cellpadding=4><tr><th>node</th><th>state</th>"
-            f"<th>ip</th><th>resources</th></tr>{rows}</table>"
-            "</body></html>"
-        ).encode()
 
     # ---------------------------------------------------------------- http
 
@@ -247,3 +236,64 @@ def main(argv=None):
 
 if __name__ == "__main__":
     main()
+
+
+_INDEX_HTML = b"""<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1d21}
+ header{background:#1a1d21;color:#fff;padding:10px 20px;display:flex;align-items:baseline;gap:14px}
+ header h1{font-size:16px;margin:0} header span{color:#9aa3ad;font-size:12px}
+ .tiles{display:flex;gap:12px;padding:16px 20px;flex-wrap:wrap}
+ .tile{background:#fff;border:1px solid #e2e5e9;border-radius:8px;padding:12px 18px;min-width:110px}
+ .tile .v{font-size:22px;font-weight:600} .tile .k{font-size:11px;color:#6b7380;text-transform:uppercase}
+ section{margin:6px 20px 18px} h2{font-size:13px;color:#6b7380;text-transform:uppercase;margin:14px 0 6px}
+ table{border-collapse:collapse;width:100%;background:#fff;border:1px solid #e2e5e9;border-radius:8px;overflow:hidden}
+ th,td{font-size:12.5px;text-align:left;padding:6px 10px;border-bottom:1px solid #eef0f3;font-variant-numeric:tabular-nums}
+ th{background:#fafbfc;color:#6b7380;font-weight:600}
+ .ALIVE,.RUNNING,.SUCCEEDED,.CREATED{color:#0a7d33;font-weight:600}
+ .DEAD,.FAILED,.ERRORED{color:#b3261e;font-weight:600}
+ .PENDING_CREATION,.PENDING,.RESTARTING,.RESCHEDULING{color:#9a6b00;font-weight:600}
+ code{background:#eef0f3;border-radius:4px;padding:1px 5px}
+</style></head><body>
+<header><h1>ray_tpu</h1><span>cluster @ __GCS__</span>
+<span id=err style="color:#ff8a80"></span></header>
+<div class=tiles id=tiles></div>
+<section><h2>Nodes</h2><table id=nodes></table></section>
+<section><h2>Actors</h2><table id=actors></table></section>
+<section><h2>Jobs</h2><table id=jobs></table></section>
+<section><h2>Placement groups</h2><table id=pgs></table></section>
+<section style="color:#6b7380;font-size:12px">JSON API under <code>/api/*</code>
+&middot; refreshes every 2s</section>
+<script>
+async function j(p){const r=await fetch(p);return r.json()}
+function row(cells,h){return '<tr>'+cells.map(c=>(h?'<th>':'<td>')+c+(h?'</th>':'</td>')).join('')+'</tr>'}
+function st(s){return '<span class="'+s+'">'+s+'</span>'}
+function fmtRes(r){return Object.entries(r||{}).map(([k,v])=>k+':'+(typeof v=='number'?Math.round(v*10)/10:v)).join(' ')}
+async function tick(){
+ try{
+  const [clusterR,nodesR,actorsR,jobsR,pgsR]=await Promise.all([
+    j('/api/cluster'),j('/api/nodes'),j('/api/actors'),j('/api/jobs'),j('/api/placement_groups')]);
+  const nodes=nodesR.nodes||[],actors=actorsR.actors||[],
+        jobs=jobsR.jobs||[],pgs=pgsR.placement_groups||[];
+  const alive=nodes.filter(n=>n.state=='ALIVE');
+  const total=(clusterR.cluster||{}).total||{},avail=(clusterR.cluster||{}).available||{};
+  document.getElementById('tiles').innerHTML=
+   [['nodes',alive.length],['actors',actors.filter(a=>a.state=='ALIVE').length],
+    ['jobs',jobs.length],['CPU',Math.round(((total.CPU||0)-(avail.CPU||0))*10)/10+' / '+(total.CPU||0)],
+    ['TPU',Math.round(((total.TPU||0)-(avail.TPU||0))*10)/10+' / '+(total.TPU||0)]]
+   .map(([k,v])=>'<div class=tile><div class=v>'+v+'</div><div class=k>'+k+'</div></div>').join('');
+  document.getElementById('nodes').innerHTML=row(['node','state','ip','total','available'],1)+
+   nodes.map(n=>row([n.node_id.slice(0,12),st(n.state),n.node_ip,fmtRes(n.resources_total),fmtRes(n.resources_available)])).join('');
+  document.getElementById('actors').innerHTML=row(['actor','class','name','state','node','restarts'],1)+
+   actors.slice(0,200).map(a=>row([a.actor_id.slice(0,12),a.class_name||'',a.name||'',st(a.state),(a.node_id||'').slice(0,12),a.num_restarts||0])).join('');
+  document.getElementById('jobs').innerHTML=row(['job','entrypoint','status','start'],1)+
+   jobs.map(x=>row([x.job_id||x.submission_id||'',(x.entrypoint||'').slice(0,80),st(x.status||x.state||''),x.start_time?new Date(x.start_time*1000).toLocaleTimeString():''])).join('');
+  document.getElementById('pgs').innerHTML=row(['pg','name','strategy','state','bundles'],1)+
+   pgs.map(p=>row([p.placement_group_id.slice(0,12),p.name||'',p.strategy,st(p.state),p.bundles.length])).join('');
+  document.getElementById('err').textContent='';
+ }catch(e){document.getElementById('err').textContent='api error: '+e}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""

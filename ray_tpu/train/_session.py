@@ -45,21 +45,39 @@ class TrainContext:
 
 class _Session:
     def __init__(self, ctx: TrainContext, latest_checkpoint: Optional[Checkpoint],
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 pipeline_depth: int = 1):
         self.ctx = ctx
         self.latest_checkpoint = latest_checkpoint
         self.dataset_shards = dataset_shards or {}
         self.reports: "queue.Queue" = queue.Queue()
         self.consumed = threading.Event()
+        # Pipelined reports (reference: _internal/session.py uses a bounded
+        # result queue): report(i) returns immediately while the driver
+        # consumes asynchronously; report(i+depth) blocks until i is acked.
+        # Strict per-report lockstep (depth 1, the Tune-trial default) puts
+        # a full driver round-trip on the step critical path; the Train
+        # worker group uses a deeper pipeline + batched drains so reporting
+        # every step costs ~nothing relative to the compiled step.
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._slot = threading.Semaphore(self.pipeline_depth)
         self.finished = False
         self.error: Optional[BaseException] = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
+        self._slot.acquire()  # wait for a free pipeline slot
         self.consumed.clear()
         self.reports.put({"metrics": metrics, "checkpoint": checkpoint})
-        # Block the training thread until the driver consumed the report —
-        # keeps workers in lockstep per report like the reference session.
-        self.consumed.wait()
+        if self.pipeline_depth == 1:
+            # strict barrier: return only after the consumer acked THIS
+            # report — Tune trial loops rely on it (a checkpoint dir may be
+            # reused right after report() returns)
+            self.consumed.wait()
+
+    def ack(self, n: int = 1):
+        self.consumed.set()
+        for _ in range(n):
+            self._slot.release()
 
 
 _session: Optional[_Session] = None
@@ -67,10 +85,11 @@ _session_lock = threading.Lock()
 
 
 def init_session(ctx: TrainContext, checkpoint: Optional[Checkpoint],
-                 dataset_shards: Optional[Dict[str, Any]] = None) -> _Session:
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 pipeline_depth: int = 1) -> _Session:
     global _session
     with _session_lock:
-        _session = _Session(ctx, checkpoint, dataset_shards)
+        _session = _Session(ctx, checkpoint, dataset_shards, pipeline_depth)
         return _session
 
 

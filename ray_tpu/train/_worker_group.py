@@ -80,6 +80,27 @@ class _TrainWorker:
         )
         return len(jax.devices())
 
+    def init_torch_process_group(self, master_ip: str, master_port: int,
+                                 world_size: int, rank: int,
+                                 backend: str = "gloo",
+                                 timeout_s: float = 120.0):
+        """torch.distributed bootstrap (reference: train/torch/config.py:65
+        _setup_torch_process_group — MASTER_ADDR/PORT + init_process_group)."""
+        import datetime
+
+        import torch.distributed as dist
+
+        os.environ["MASTER_ADDR"] = master_ip
+        os.environ["MASTER_PORT"] = str(master_port)
+        dist.init_process_group(
+            backend=backend,
+            init_method=f"tcp://{master_ip}:{master_port}",
+            world_size=world_size,
+            rank=rank,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+        return dist.get_rank()
+
     def start_run(
         self,
         train_fn: Callable,
@@ -87,8 +108,9 @@ class _TrainWorker:
         ctx: TrainContext,
         checkpoint: Optional[Checkpoint],
         dataset_shards: Optional[Dict[str, Any]] = None,
+        pipeline_depth: int = 1,
     ):
-        session = init_session(ctx, checkpoint, dataset_shards)
+        session = init_session(ctx, checkpoint, dataset_shards, pipeline_depth)
 
         import inspect
 
@@ -115,10 +137,8 @@ class _TrainWorker:
         self._thread.start()
         return True
 
-    def next_report(self) -> dict:
-        """Block until the worker's loop reports, errors, or finishes."""
+    def _report_to_wire(self, item) -> dict:
         session = get_session()
-        item = session.reports.get()
         if item is None:
             if session.error is not None:
                 return {
@@ -133,10 +153,37 @@ class _TrainWorker:
             out["checkpoint_path"] = ckpt.path
         return out
 
-    def ack_report(self):
+    def next_report(self) -> dict:
+        """Block until the worker's loop reports, errors, or finishes."""
+        return self._report_to_wire(get_session().reports.get())
+
+    def drain_reports(self, ack: int = 0) -> List[dict]:
+        """Non-blocking batched drain with piggybacked acks — the Train
+        driver's consumption path. Crucially there is NO thread parked on
+        the report queue: report() is then a bare deque append, so the
+        training thread's jax dispatch is never preempted by report-handler
+        wakeups (at ~2ms TPU steps, per-report GIL handoffs measured ~3.6
+        ms/step). The driver polls at 20Hz; Tune keeps the blocking
+        per-report next_report so schedulers decide on every round."""
+        import queue as _q
+
+        session = get_session()
+        if ack:
+            session.ack(ack)
+        items = []
+        while True:
+            try:
+                items.append(session.reports.get_nowait())
+            except _q.Empty:
+                break
+            if items[-1] is None:
+                break
+        return [self._report_to_wire(i) for i in items]
+
+    def ack_report(self, n: int = 1):
         session = get_session()
         if session is not None:
-            session.consumed.set()
+            session.ack(n)
         return True
 
     def upload_checkpoint(self, local_path: str, experiment_uri: str,

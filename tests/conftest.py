@@ -13,6 +13,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
 )
+# Validate every RPC payload against the typed wire contracts
+# (_private/schema.py) in all cluster tests — contract drift fails loudly.
+os.environ.setdefault("RTPU_VALIDATE_RPC", "1")
 
 # A pytest plugin may have imported jax before this file ran, baking the
 # ambient JAX_PLATFORMS into its config; override it (backends are lazy, so

@@ -103,7 +103,7 @@ def test_dashboard_api_and_rest_jobs(job_cluster, tmp_path):
     assert nodes[0]["state"] == "ALIVE"
     with urllib.request.urlopen(f"{base}/", timeout=30) as r:
         html = r.read().decode()
-    assert "ray_tpu cluster" in html
+    assert "ray_tpu" in html and "id=tiles" in html  # live SPA served at /
 
     client = JobSubmissionClient(base)  # REST transport
     sid = client.submit_job(

