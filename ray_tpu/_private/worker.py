@@ -753,6 +753,13 @@ class CoreWorker:
             else:
                 pending.append(ref)
         if len(ready) >= num_returns or not pending:
+            # cap at num_returns (reference semantics); surplus ready refs
+            # stay in pending, still in input order
+            surplus = ready[num_returns:]
+            ready = ready[:num_returns]
+            if surplus:
+                keep = set(surplus) | set(pending)
+                pending = [r for r in refs if r in keep]
             return ready, pending
         waiters = {
             asyncio.ensure_future(self._wait_one(ref)): ref
@@ -775,9 +782,14 @@ class CoreWorker:
         finally:
             for t in waiters:
                 t.cancel()
-        # preserve input order in both lists (reference semantics)
+        # Never return MORE than num_returns ready refs (reference
+        # semantics: len(ready) <= num_returns) — several waiters can
+        # complete in one asyncio.wait round; the surplus goes back to
+        # pending so callers looping wait(num_returns=1) see every ref.
         ready_set = set(ready)
-        ready = [r for r in refs if r in ready_set]
+        ordered_ready = [r for r in refs if r in ready_set]
+        ready = ordered_ready[:num_returns]
+        ready_set = set(ready)
         pending = [r for r in refs if r not in ready_set]
         return ready, pending
 

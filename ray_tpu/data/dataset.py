@@ -374,6 +374,71 @@ class Dataset:
         for block in self.iter_batches(batch_size=None):
             yield from rows_of(block)
 
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, dtypes=None, drop_last: bool = False,
+                         prefetch_blocks: int = 2) -> Iterator[Dict[str, Any]]:
+        """iter_batches with each column placed on device as a jax array
+        (reference: iterator.iter_torch_batches — the jax-first analogue).
+        `sharding` is an optional jax.sharding.Sharding (e.g. a batch
+        NamedSharding over a mesh's dp axis) applied by device_put; ingest
+        of the NEXT batch overlaps with the caller's step on the current
+        one via the streaming executor."""
+        import jax
+        import jax.numpy as jnp
+
+        n_shards = 1
+        if sharding is not None:
+            n_shards = getattr(sharding, "num_devices", None) or len(
+                getattr(sharding, "device_set", [1]))
+        for block in self.iter_batches(batch_size=batch_size,
+                                       prefetch_blocks=prefetch_blocks):
+            if not isinstance(block, dict):
+                raise TypeError("iter_jax_batches requires column blocks")
+            rows = block_num_rows(block)
+            if sharding is not None and rows % n_shards:
+                # a partial final batch can't be laid out on the mesh axis
+                if drop_last:
+                    continue
+                raise ValueError(
+                    f"final batch of {rows} rows is not divisible by the "
+                    f"{n_shards}-way sharding; pass drop_last=True (or a "
+                    "batch_size divisible by the mesh axis)"
+                )
+            out = {}
+            for k, v in block.items():
+                arr = np.asarray(v)
+                if dtypes and k in dtypes:
+                    arr = arr.astype(dtypes[k])
+                out[k] = (jax.device_put(arr, sharding)
+                          if sharding is not None else jnp.asarray(arr))
+            yield out
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, prefetch_blocks: int = 2
+                           ) -> Iterator[Dict[str, Any]]:
+        """iter_batches as dicts of torch tensors
+        (reference: data/iterator.py iter_torch_batches)."""
+        import torch
+
+        for block in self.iter_batches(batch_size=batch_size,
+                                       prefetch_blocks=prefetch_blocks):
+            if not isinstance(block, dict):
+                raise TypeError("iter_torch_batches requires column blocks")
+            out = {}
+            for k, v in block.items():
+                arr = np.ascontiguousarray(v)
+                t = torch.from_numpy(arr)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
+    def streaming_split(self, n: int) -> List["Dataset"]:
+        """Reference-named alias of split_blocks: n lazy shards that keep
+        streaming through the pending operator chain (reference:
+        Dataset.streaming_split — Train ingest path)."""
+        return self.split_blocks(n)
+
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
         for row in self.iter_rows():

@@ -152,3 +152,46 @@ def test_concatenator_chain(data_cluster):
     row = out.take(1)[0]
     assert row["features"].shape == (2,)
     assert "a" not in row and "b" not in row and "y" in row
+
+
+def test_iter_jax_batches(data_cluster):
+    import jax.numpy as jnp
+
+    ds = rd.range(100, override_num_blocks=4)
+    total = 0
+    for b in ds.iter_jax_batches(batch_size=32):
+        assert isinstance(b["id"], jnp.ndarray)
+        total += len(b["id"])
+    assert total == 100
+
+
+def test_iter_jax_batches_sharded(data_cluster):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P("dp"))
+    ds = rd.range(64, override_num_blocks=2)
+    for b in ds.iter_jax_batches(batch_size=32, sharding=sh):
+        assert len(b["id"].sharding.device_set) == 4
+
+
+def test_iter_torch_batches(data_cluster):
+    import torch
+
+    ds = rd.from_items([{"x": float(i)} for i in range(50)])
+    seen = 0
+    for b in ds.iter_torch_batches(batch_size=16,
+                                   dtypes={"x": torch.float32}):
+        assert isinstance(b["x"], torch.Tensor)
+        assert b["x"].dtype == torch.float32
+        seen += len(b["x"])
+    assert seen == 50
+
+
+def test_streaming_split_alias(data_cluster):
+    shards = rd.range(100, override_num_blocks=4).streaming_split(2)
+    assert len(shards) == 2
+    assert sum(s.count() for s in shards) == 100

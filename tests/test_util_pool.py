@@ -1,0 +1,57 @@
+"""ray_tpu.util.multiprocessing Pool shim tests
+(reference: python/ray/tests/test_multiprocessing.py subset)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+@pytest.fixture(scope="module")
+def pool_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_map_starmap(pool_cluster):
+    with Pool(4) as p:
+        assert p.map(_sq, range(20)) == [x * x for x in range(20)]
+        assert p.starmap(_add, [(1, 2), (3, 4), (5, 6)]) == [3, 7, 11]
+
+
+def test_apply_and_async(pool_cluster):
+    with Pool(2) as p:
+        assert p.apply(_add, (2, 3)) == 5
+        r = p.apply_async(_sq, (9,))
+        assert r.get(timeout=30) == 81
+        m = p.map_async(_sq, [1, 2, 3])
+        assert m.get(timeout=30) == [1, 4, 9]
+        assert m.ready() and m.successful()
+
+
+def test_imap_ordered_and_unordered(pool_cluster):
+    with Pool(4) as p:
+        assert list(p.imap(_sq, range(10), chunksize=3)) == [
+            x * x for x in range(10)
+        ]
+        assert sorted(p.imap_unordered(_sq, range(10), chunksize=2)) == [
+            x * x for x in range(10)
+        ]
+
+
+def test_closed_pool_rejects(pool_cluster):
+    p = Pool(2)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+    p.terminate()
+    p.join()
