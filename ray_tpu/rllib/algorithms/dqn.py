@@ -199,6 +199,22 @@ class DQNLearner:
 
         return jax.tree.map(np.asarray, self.params)
 
+    def set_weights(self, weights, target_weights=None):
+        import jax
+
+        self.params = jax.device_put(weights, self._replicated)
+        self.target_params = jax.device_put(
+            target_weights if target_weights is not None else weights,
+            self._replicated,
+        )
+        self.opt_state = self.opt.init(self.params)
+        return True
+
+    def get_target_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.target_params)
+
     def num_devices(self) -> int:
         return self.mesh.size
 
@@ -344,6 +360,58 @@ class DQN:
 
     def get_weights(self):
         return self._weights
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        """Persist online+target weights, config and counters (reference:
+        Algorithm.save / Checkpointable)."""
+        import os
+        import tempfile
+
+        import cloudpickle
+
+        path = checkpoint_dir or tempfile.mkdtemp(prefix="dqn_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        target = self._learner_call("get_target_weights")
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            cloudpickle.dump({
+                "algo": "DQN",
+                "config": self.config,
+                "weights": self._weights,
+                "target_weights": target,
+                "iteration": self._iteration,
+                "timesteps": self._timesteps,
+            }, f)
+        return path
+
+    def restore(self, checkpoint_path: str, _state: dict = None):
+        import os
+
+        import cloudpickle
+
+        if _state is not None:
+            state = _state
+        else:
+            with open(os.path.join(checkpoint_path, "algorithm_state.pkl"),
+                      "rb") as f:
+                state = cloudpickle.load(f)
+        self._weights = state["weights"]
+        self._iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+        self._learner_call("set_weights", state["weights"],
+                           state.get("target_weights"))
+        return self
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_path: str) -> "DQN":
+        import os
+
+        import cloudpickle
+
+        with open(os.path.join(checkpoint_path, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = cloudpickle.load(f)
+        algo = cls(state["config"])
+        return algo.restore(checkpoint_path, _state=state)
 
     def stop(self):
         for r in self.runners:

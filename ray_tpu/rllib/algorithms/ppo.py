@@ -135,6 +135,55 @@ class PPO:
     def get_weights(self):
         return self._weights
 
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        """Persist weights + config + counters (reference:
+        Algorithm.save / Checkpointable)."""
+        import os
+        import tempfile
+
+        import cloudpickle
+
+        path = checkpoint_dir or tempfile.mkdtemp(prefix="ppo_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            cloudpickle.dump({
+                "algo": "PPO",
+                "config": self.config,
+                "weights": self._weights,
+                "iteration": self._iteration,
+                "timesteps": self._timesteps,
+            }, f)
+        return path
+
+    def restore(self, checkpoint_path: str, _state: dict = None):
+        import os
+
+        import cloudpickle
+
+        if _state is not None:
+            state = _state
+        else:
+            with open(os.path.join(checkpoint_path, "algorithm_state.pkl"),
+                      "rb") as f:
+                state = cloudpickle.load(f)
+        self._weights = state["weights"]
+        self._iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+        self.learner_group.set_weights(self._weights)
+        return self
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_path: str) -> "PPO":
+        import os
+
+        import cloudpickle
+
+        with open(os.path.join(checkpoint_path, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = cloudpickle.load(f)
+        algo = cls(state["config"])
+        return algo.restore(checkpoint_path, _state=state)
+
     def stop(self):
         self.env_runner_group.shutdown()
         self.learner_group.shutdown()

@@ -116,6 +116,16 @@ class JaxLearner:
 
         return jax.tree.map(np.asarray, self.params)
 
+    def set_weights(self, weights):
+        """Load a host-side weight pytree onto the mesh (checkpoint
+        restore; opt state restarts fresh like the reference's
+        from_checkpoint on a new Learner)."""
+        import jax
+
+        self.params = jax.device_put(weights, self._replicated)
+        self.opt_state = self.opt.init(self.params)
+        return True
+
     def num_devices(self) -> int:
         return self.mesh.size
 
@@ -155,6 +165,12 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.get_weights()
         return ray_tpu.get(self._actor.get_weights.remote(), timeout=60)
+
+    def set_weights(self, weights):
+        if self._local is not None:
+            return self._local.set_weights(weights)
+        return ray_tpu.get(self._actor.set_weights.remote(weights),
+                           timeout=120)
 
     def num_devices(self) -> int:
         if self._local is not None:

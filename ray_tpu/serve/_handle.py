@@ -36,12 +36,66 @@ class DeploymentResponse:
         return self._ref
 
 
+class StreamingResponse:
+    """Iterator over a streaming deployment call (reference:
+    serve/handle.py DeploymentResponseGenerator): the replica runs the
+    generator; items arrive in pulled batches."""
+
+    def __init__(self, replica, stream_id: str, handle, idx: int):
+        self._replica = replica
+        self._stream_id = stream_id
+        self._handle = handle
+        self._idx = idx
+        self._buf: List[Any] = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        while not self._buf:
+            if self._done:
+                self._finish()
+                raise StopIteration
+            reply = ray_tpu.get(
+                self._replica.next_stream_items.remote(self._stream_id),
+                timeout=120,
+            )
+            self._buf.extend(reply["items"])
+            self._done = reply["done"]
+        return self._buf.pop(0)
+
+    def _finish(self):
+        if self._handle is not None:
+            self._handle._done(self._idx)
+            self._handle = None
+
+    def close(self):
+        """Abandon the stream: frees the replica-side generator."""
+        if not self._done:
+            self._done = True
+            try:
+                self._replica.cancel_stream.remote(self._stream_id)
+            except Exception:
+                pass
+        self._finish()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self._method = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
         self._fetched_at = 0.0
@@ -49,22 +103,25 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self._method, self._model_id))
+                (self.deployment_name, self._method, self._model_id,
+                 self._stream))
 
     def options(self, method_name: Optional[str] = None, *,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._model_id,
+            self._stream if stream is None else stream,
         )
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name, self._model_id)
+        return DeploymentHandle(self.deployment_name, name, self._model_id,
+                                self._stream)
 
     def _refresh_replicas(self, force: bool = False):
         now = time.time()
@@ -131,6 +188,15 @@ class DeploymentHandle:
                 if self._model_id:
                     kwargs = {**kwargs,
                               "__multiplexed_model_id": self._model_id}
+                if self._stream:
+                    import ray_tpu
+
+                    sid = ray_tpu.get(
+                        replica.start_stream.remote(
+                            self._method, args, kwargs),
+                        timeout=60,
+                    )
+                    return StreamingResponse(replica, sid, self, idx)
                 ref = replica.handle_request.remote(
                     self._method, args, kwargs
                 )

@@ -78,6 +78,107 @@ class Replica:
             self._ongoing -= 1
             self._handled += 1
 
+    # ------------------------------------------------------------ streaming
+
+    async def start_stream(self, method: str, args: tuple, kwargs: dict) -> str:
+        """Begin a streaming call: the target returns a (sync or async)
+        generator; items are pulled in batches via next_stream_items
+        (reference: serve's streaming responses, replica.py generator
+        handling)."""
+        import uuid
+
+        import time as _time
+
+        model_id = kwargs.pop("__multiplexed_model_id", "")
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_current_model_id
+
+            _set_current_model_id(model_id)
+        target = (self._callable if self._is_function
+                  else getattr(self._callable, method or "__call__"))
+        gen = target(*args, **kwargs)
+        if inspect.iscoroutine(gen):
+            gen = await gen
+        sid = uuid.uuid4().hex
+        if not hasattr(self, "_streams"):
+            self._streams = {}
+        # model_id stored with the stream: the generator body executes in
+        # next_stream_items' task context, not this one
+        self._streams[sid] = {"gen": gen, "model_id": model_id,
+                              "last_pull": _time.time()}
+        self._ongoing += 1
+        return sid
+
+    async def cancel_stream(self, stream_id: str):
+        """Client-side abandonment (StreamingResponse.close/__del__)."""
+        self._drop_stream(stream_id)
+        return True
+
+    def _drop_stream(self, stream_id: str):
+        rec = getattr(self, "_streams", {}).pop(stream_id, None)
+        if rec is not None:
+            self._ongoing -= 1
+            self._handled += 1
+
+    def _reap_idle_streams(self, max_idle_s: float = 300.0):
+        """Abandoned streams (client died mid-iteration) must not pin
+        _ongoing/memory forever; called from the metrics push loop."""
+        import time as _time
+
+        now = _time.time()
+        for sid, rec in list(getattr(self, "_streams", {}).items()):
+            if now - rec["last_pull"] > max_idle_s:
+                self._drop_stream(sid)
+
+    async def next_stream_items(self, stream_id: str,
+                                max_items: int = 16) -> dict:
+        """Pull up to max_items from the stream; done=True ends it."""
+        import time as _time
+
+        rec = getattr(self, "_streams", {}).get(stream_id)
+        if rec is None:
+            return {"items": [], "done": True}
+        rec["last_pull"] = _time.time()
+        gen = rec["gen"]
+        if rec["model_id"]:
+            from ray_tpu.serve.multiplex import _set_current_model_id
+
+            _set_current_model_id(rec["model_id"])
+        items = []
+        done = False
+        try:
+            if inspect.isasyncgen(gen):
+                for _ in range(max_items):
+                    try:
+                        items.append(await gen.__anext__())
+                    except StopAsyncIteration:
+                        done = True
+                        break
+            else:
+                import asyncio as _asyncio
+                import contextvars as _cv
+                import functools as _functools
+
+                def pull():
+                    out = []
+                    for _ in range(max_items):
+                        try:
+                            out.append(next(gen))
+                        except StopIteration:
+                            return out, True
+                    return out, False
+
+                loop = _asyncio.get_running_loop()
+                ctx = _cv.copy_context()  # carries the model id
+                items, done = await loop.run_in_executor(
+                    None, ctx.run, _functools.partial(pull))
+        except Exception:
+            self._drop_stream(stream_id)
+            raise
+        if done:
+            self._drop_stream(stream_id)
+        return {"items": items, "done": done}
+
     def get_metadata(self) -> dict:
         return {"ongoing": self._ongoing, "handled": self._handled}
 
@@ -107,6 +208,10 @@ class Replica:
             last_health_check = 0.0
             while True:
                 now = _time.time()
+                try:
+                    self._reap_idle_streams()
+                except Exception:
+                    pass
                 if now - last_health_check >= health_check_period_s:
                     last_health_check = now
                     try:

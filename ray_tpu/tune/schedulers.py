@@ -1,8 +1,10 @@
-"""Trial schedulers: FIFO, ASHA (async successive halving), PBT.
+"""Trial schedulers: FIFO, ASHA (async successive halving), PBT,
+median-stopping, HyperBand.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py:19 (ASHA brackets /
-rung cutoffs) and schedulers/pbt.py:221 (exploit top quantile + explore by
-perturbation at a fixed interval).
+rung cutoffs), schedulers/pbt.py:221 (exploit top quantile + explore by
+perturbation at a fixed interval), schedulers/median_stopping_rule.py,
+schedulers/hyperband.py.
 """
 
 from __future__ import annotations
@@ -149,3 +151,101 @@ class PopulationBasedTraining(FIFOScheduler):
                 self.num_perturbations += 1
                 return EXPLOIT
         return CONTINUE
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' running averages at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 4, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        # trial_id -> list of metric values per reported step
+        self._history: Dict[str, List[float]] = {}
+
+    def _score(self, result) -> float:
+        v = float(result.get(self.metric, 0.0))
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        if self.metric not in result:
+            return CONTINUE  # warm-up / heartbeat rounds carry no metric
+        hist = self._history.setdefault(trial.id, [])
+        hist.append(self._score(result))
+        step = len(hist)
+        if step <= self.grace_period:
+            return CONTINUE
+        # running averages of OTHER trials truncated to this step
+        others = [
+            sum(h[:step]) / min(step, len(h))
+            for tid, h in self._history.items()
+            if tid != trial.id and len(h) >= 1
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = sum(hist) / len(hist)
+        return STOP if mine < median else CONTINUE
+
+
+class HyperBandScheduler(FIFOScheduler):
+    """Synchronous-flavor HyperBand approximated asynchronously: trials are
+    assigned round-robin to brackets with different starting rungs, each
+    bracket running successive halving (reference: schedulers/hyperband.py;
+    asynchronous assignment like ASHA so stragglers can't stall a bracket)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, reduction_factor: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # bracket b starts halving at rung rf^b
+        # integer loop, not int(log()): FP rounds log(243, 3) down to
+        # 4.999..., silently losing the no-early-stopping bracket
+        self.num_brackets = 1
+        t = reduction_factor
+        while t <= max_t:
+            self.num_brackets += 1
+            t *= reduction_factor
+        self._brackets: List[List[_Rung]] = []
+        for b in range(self.num_brackets):
+            milestones = []
+            t = reduction_factor ** b
+            while t <= max_t:
+                milestones.append(t)
+                t *= reduction_factor
+            self._brackets.append([_Rung(m) for m in reversed(milestones)])
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def _score(self, result) -> float:
+        v = float(result.get(self.metric, 0.0))
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        if self.metric not in result:
+            return CONTINUE  # warm-up / heartbeat rounds carry no metric
+        b = self._assignment.get(trial.id)
+        if b is None:
+            b = self._next_bracket % self.num_brackets
+            self._next_bracket += 1
+            self._assignment[trial.id] = b
+        step = int(result.get("training_iteration", trial.iteration))
+        score = self._score(result)
+        decision = CONTINUE
+        for rung in self._brackets[b]:  # highest milestone first
+            if step >= rung.milestone and trial.id not in rung.recorded:
+                rung.recorded[trial.id] = score
+                cutoff = rung.cutoff(self.rf)
+                if cutoff is not None and score < cutoff:
+                    decision = STOP
+                break
+        if step >= self.max_t:
+            decision = STOP
+        return decision

@@ -7,7 +7,7 @@ from typing import TYPE_CHECKING
 if TYPE_CHECKING:
     from ray_tpu.util import collective  # noqa: F401
 
-_LAZY_SUBMODULES = ("client", "collective", "multiprocessing", "placement_group", "queue", "state")
+_LAZY_SUBMODULES = ("check_serialize", "client", "collective", "multiprocessing", "placement_group", "queue", "state")
 
 
 def __getattr__(name):

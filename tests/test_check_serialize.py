@@ -1,0 +1,33 @@
+"""inspect_serializability tests (reference: util/check_serialize.py)."""
+
+import threading
+
+from ray_tpu.util.check_serialize import inspect_serializability
+
+
+def test_serializable_object():
+    ok, failures = inspect_serializability({"a": [1, 2], "b": "x"})
+    assert ok and not failures
+
+
+def test_finds_bad_closure():
+    lock = threading.Lock()
+
+    def f():
+        return lock
+
+    ok, failures = inspect_serializability(f, print_failures=False)
+    assert not ok
+    assert any(fail.name == "lock" for fail in failures)
+
+
+def test_finds_bad_attribute():
+    class Holder:
+        def __init__(self):
+            self.fine = 1
+            self.bad = threading.Lock()
+
+    ok, failures = inspect_serializability(Holder(), print_failures=False)
+    assert not ok
+    assert any(fail.name == "bad" and fail.parent == "Holder"
+               for fail in failures)

@@ -76,3 +76,33 @@ def test_ppo_cartpole_learns(rl_cluster):
         assert best >= 150, f"PPO failed to learn CartPole: best={best:.1f}"
     finally:
         algo.stop()
+
+
+def test_ppo_save_restore(rl_cluster, tmp_path):
+    import jax
+    import numpy as np
+
+    from ray_tpu.rllib import PPO
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                     rollout_fragment_length=32)
+        .build()
+    )
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ck"))
+        w0 = algo.get_weights()
+    finally:
+        algo.stop()
+
+    algo2 = PPO.from_checkpoint(path)
+    try:
+        for a, b in zip(jax.tree.leaves(w0),
+                        jax.tree.leaves(algo2.get_weights())):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        algo2.train()
+    finally:
+        algo2.stop()

@@ -74,3 +74,39 @@ def test_dqn_cartpole_learns(rl_cluster):
         assert best >= 130, f"DQN failed to learn CartPole: best={best:.1f}"
     finally:
         algo.stop()
+
+
+def test_dqn_save_restore(rl_cluster, tmp_path):
+    """Checkpointable surface: save -> from_checkpoint restores weights,
+    target net and counters (reference: Algorithm.save/from_checkpoint)."""
+    import jax
+
+    from ray_tpu.rllib import DQN
+
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=4,
+                     rollout_fragment_length=16)
+        .training(learning_starts=64, updates_per_iteration=4)
+        .build()
+    )
+    try:
+        for _ in range(3):
+            algo.train()
+        path = algo.save(str(tmp_path / "ck"))
+        w0 = algo.get_weights()
+        it0 = algo._iteration
+    finally:
+        algo.stop()
+
+    algo2 = DQN.from_checkpoint(path)
+    try:
+        w1 = algo2.get_weights()
+        for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert algo2._iteration == it0
+        r = algo2.train()  # resumes counting from the checkpoint
+        assert r["training_iteration"] == it0 + 1
+    finally:
+        algo2.stop()

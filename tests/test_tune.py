@@ -257,3 +257,86 @@ def test_trainer_fit_is_one_trial_tune_run(tune_cluster):
     assert os.path.exists(
         os.path.join(trainer.experiment_dir, "experiment_state.json")
     )
+
+
+def test_median_stopping_rule():
+    """Unit: a trial whose running average trails the median is stopped
+    after the grace period (reference: schedulers/median_stopping_rule.py)."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, MedianStoppingRule
+
+    class _T:
+        def __init__(self, tid):
+            self.id = tid
+            self.iteration = 0
+
+    sched = MedianStoppingRule(metric="score", mode="max", grace_period=2,
+                               min_samples_required=3)
+    good = [_T(f"g{i}") for i in range(3)]
+    bad = _T("bad")
+    for step in range(1, 7):
+        for t in good:
+            assert sched.on_trial_result(None, t, {"score": 10.0}) == CONTINUE
+        decision = sched.on_trial_result(None, bad, {"score": 1.0})
+    assert decision == STOP
+
+
+def test_hyperband_scheduler_brackets():
+    """Unit: bracket assignment round-robins; bottom scorers at a rung get
+    stopped, everyone stops at max_t."""
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, HyperBandScheduler
+
+    class _T:
+        def __init__(self, tid):
+            self.id = tid
+            self.iteration = 0
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               reduction_factor=3)
+    assert sched.num_brackets == 3
+    trials = [_T(f"t{i}") for i in range(6)]
+    # all trials report at step 1 with spread scores
+    decisions = [
+        sched.on_trial_result(None, t, {"score": float(i),
+                                        "training_iteration": 1})
+        for i, t in enumerate(trials)
+    ]
+    assert CONTINUE in decisions
+    # a terrible score arriving at a populated rung is stopped
+    late = _T("late")
+    sched._assignment["late"] = 0  # same bracket as t0/t3
+    d = sched.on_trial_result(None, late, {"score": -100.0,
+                                           "training_iteration": 1})
+    assert d == STOP
+    # max_t always stops
+    assert sched.on_trial_result(
+        None, trials[0], {"score": 100.0, "training_iteration": 9}
+    ) == STOP
+
+
+def test_median_stopping_e2e(tune_cluster):
+    """16 trials, half clearly worse: median stopping prunes losers while a
+    winner completes."""
+    from ray_tpu.train._config import RunConfig
+    from ray_tpu.tune.schedulers import MedianStoppingRule
+
+    def objective(config):
+        score = 0.0
+        for _ in range(16):
+            score += config["lr"]
+            tune.report({"score": score})
+
+    tuner = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search(
+            [0.01 * (i + 1) for i in range(8)]
+        )},
+        tune_config=TuneConfig(scheduler=MedianStoppingRule(
+            metric="score", mode="max", grace_period=3)),
+        run_config=RunConfig(name="median8", storage_path=_exp_dir()),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    iters = [r.metrics.get("training_iteration", 0) for r in grid]
+    assert max(iters) == 16
+    best = grid.get_best_result("score")
+    assert best.config["lr"] == pytest.approx(0.08)
