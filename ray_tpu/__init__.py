@@ -63,4 +63,9 @@ def __getattr__(name):
         import ray_tpu.cluster_utils as _cu
 
         return _cu
+    if name in ("train", "tune", "data", "serve", "rllib", "workflow",
+                "dag", "autoscaler", "job_submission"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
     raise AttributeError(f"module 'ray_tpu' has no attribute '{name}'")

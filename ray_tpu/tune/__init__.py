@@ -22,4 +22,9 @@ from ray_tpu.tune.search_space import (  # noqa: F401
     randint,
     uniform,
 )
-from ray_tpu.tune.tuner import TuneConfig, Tuner, with_resources  # noqa: F401
+from ray_tpu.tune.tuner import (  # noqa: F401
+    TuneConfig,
+    Tuner,
+    with_parameters,
+    with_resources,
+)

@@ -43,6 +43,33 @@ def with_resources(trainable: Callable, resources: Dict[str, float]):
     return trainable
 
 
+def with_parameters(trainable: Callable, **kwargs):
+    """Bind large objects to a trainable via the object store (reference:
+    tune.with_parameters — datasets/models are put once and fetched
+    zero-copy by each trial instead of being pickled into every trial's
+    config)."""
+    import ray_tpu
+    from ray_tpu.train._trainer import DataParallelTrainer
+
+    if isinstance(trainable, DataParallelTrainer):
+        # match the reference: trainers carry their own config/datasets —
+        # wrapping one would silently bypass the Tuner's trainer path
+        raise ValueError(
+            "tune.with_parameters() only supports function trainables; "
+            "pass datasets/config to the trainer directly"
+        )
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    wrapped.__name__ = getattr(trainable, "__name__", "trainable")
+    if hasattr(trainable, "_tune_resources"):
+        wrapped._tune_resources = trainable._tune_resources
+    return wrapped
+
+
 def _trainer_trial_fn(config):
     """Runs a DataParallelTrainer inside a trial actor, forwarding every
     inner report round to the trial's session."""

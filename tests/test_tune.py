@@ -340,3 +340,26 @@ def test_median_stopping_e2e(tune_cluster):
     assert max(iters) == 16
     best = grid.get_best_result("score")
     assert best.config["lr"] == pytest.approx(0.08)
+
+
+def test_with_parameters(tune_cluster):
+    """Large objects bind through the object store, not per-trial configs
+    (reference: tune.with_parameters)."""
+    import numpy as np
+
+    from ray_tpu.train._config import RunConfig
+
+    big = np.arange(100_000, dtype=np.float64)
+
+    def objective(config, data=None):
+        tune.report({"score": float(data.sum()) * config["w"]})
+
+    grid = Tuner(
+        tune.with_parameters(objective, data=big),
+        param_space={"w": tune.grid_search([1.0, 2.0])},
+        run_config=RunConfig(name="withparams", storage_path=_exp_dir()),
+    ).fit()
+    assert not grid.errors
+    best = grid.get_best_result("score")
+    assert best.config["w"] == 2.0
+    assert best.metrics["score"] == pytest.approx(big.sum() * 2.0)
