@@ -95,7 +95,8 @@ class ProxyActor:
             if length:
                 body = await reader.readexactly(length)
 
-            handle = await self._route(path.split("?")[0])
+            raw_path, _, query = path.partition("?")
+            handle = await self._route(raw_path)
             if handle is None:
                 await self._respond(writer, 404, b'{"error": "no route"}')
                 return
@@ -107,6 +108,15 @@ class ProxyActor:
                 except Exception:
                     arg = body
             loop = asyncio.get_running_loop()
+
+            # ?stream=1 → chunked transfer, one chunk per generator item
+            # (reference: serve streaming responses over HTTP, proxy.py).
+            # Exact param match: substring matching would catch ?upstream=1.
+            if "stream=1" in query.split("&"):
+                await self._stream_response(
+                    writer, loop, handle, method, body, arg
+                )
+                return
 
             def _call():
                 if method == "GET" and not body:
@@ -137,6 +147,71 @@ class ProxyActor:
         finally:
             try:
                 writer.close()
+            except Exception:
+                pass
+
+    async def _stream_response(self, writer, loop, handle, method, body, arg):
+        """HTTP chunked transfer: each generator item becomes one chunk
+        (newline-delimited; JSON for non-str/bytes items). The first item is
+        pulled BEFORE committing the status line, so an immediately-failing
+        generator still gets a 500 like the non-streaming path."""
+        if not hasattr(self, "_stream_handles"):
+            self._stream_handles = {}
+        # cached per ingress: a fresh handle per request would re-fetch
+        # replicas from the controller and reset the p2c in-flight view
+        h = self._stream_handles.get(handle.deployment_name)
+        if h is None:
+            h = handle.options(stream=True)
+            self._stream_handles[handle.deployment_name] = h
+
+        _END = object()
+        state = {}
+
+        def _start_and_first():
+            stream = (h.remote() if (method == "GET" and not body)
+                      else h.remote(arg))
+            state["stream"] = stream
+            try:
+                return next(stream)
+            except StopIteration:
+                return _END
+
+        def _next():
+            try:
+                return next(state["stream"])
+            except StopIteration:
+                return _END
+
+        try:
+            item = await loop.run_in_executor(self._pool, _start_and_first)
+        except Exception as e:
+            await self._respond(
+                writer, 500, json.dumps({"error": str(e)}).encode())
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/plain; charset=utf-8\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        try:
+            while item is not _END:
+                if isinstance(item, (bytes, bytearray)):
+                    data = bytes(item)
+                elif isinstance(item, str):
+                    data = item.encode()
+                else:
+                    data = json.dumps(item).encode()
+                data += b"\n"
+                writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                await writer.drain()
+                item = await loop.run_in_executor(self._pool, _next)
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except Exception:
+            logger.exception("streaming response failed")
+            try:
+                state["stream"].close()
             except Exception:
                 pass
 

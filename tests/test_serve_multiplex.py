@@ -109,3 +109,35 @@ def test_streaming_response(serve_cluster):
         # calling a generator without stream=True returns the generator
         # object which cannot serialize cleanly — streaming must be explicit
         handle.remote(3).result(timeout=10)
+
+
+def test_http_streaming(serve_cluster):
+    """?stream=1 streams generator items as HTTP chunks through the proxy
+    (reference: serve streaming responses over HTTP)."""
+    import urllib.request
+
+    serve = serve_cluster
+
+    @serve.deployment
+    class Gen:
+        def __call__(self, n):
+            for i in range(int(n)):
+                yield {"i": i}
+
+    serve.run(Gen.bind(), name="httpstream", route_prefix="/gen")
+    import ray_tpu
+
+    port = ray_tpu.get(
+        ray_tpu.get_actor(serve.CONTROLLER_NAME).ensure_proxy.remote(),
+        timeout=60,
+    )
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/gen?stream=1",
+        data=b"5", headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.headers.get("Transfer-Encoding") == "chunked"
+        lines = [ln for ln in r.read().decode().splitlines() if ln]
+    import json as _json
+
+    assert [_json.loads(ln)["i"] for ln in lines] == [0, 1, 2, 3, 4]
