@@ -86,6 +86,8 @@ class DashboardHead:
             from ray_tpu._version import version
 
             return 200, {"version": version}
+        if path.startswith("/api/logs"):
+            return self._logs_api(path, query or {})
         if path.startswith("/api/jobs"):
             return self._jobs_api(path, method, body, query or {})
         if path == "/" or path == "/index.html":
@@ -120,6 +122,56 @@ class DashboardHead:
         except ValueError as e:
             return 404, {"error": str(e)}
         return 404, {"error": f"no route {path}"}
+
+    def _session_dir(self) -> str:
+        """Cluster session dir from the GCS, cached (it never changes);
+        same fallback as JobManager._session_dir on a transient GCS error."""
+        if getattr(self, "_session_dir_cache", None):
+            return self._session_dir_cache
+        try:
+            info = self._gcs_client().call("GetInternalConfig", {})
+            self._session_dir_cache = info.get("session_dir") or ""
+        except Exception:
+            return ""
+        return self._session_dir_cache
+
+    def _logs_api(self, path: str, query):
+        """Session log files (reference: dashboard log module —
+        dashboard/modules/log/ serves per-process logs over HTTP).
+
+        GET /api/logs            list {name, size_bytes}
+        GET /api/logs/<name>     {"lines": [...]} — ?tail=N (default 200)
+        """
+        import os
+        from collections import deque
+
+        log_dir = os.path.join(self._session_dir(), "logs")
+        if not os.path.isdir(log_dir):
+            return 404, {"error": "no session log directory"}
+        parts = [p for p in path.split("/") if p]  # ["api","logs",...]
+        if len(parts) == 2:
+            files = sorted(os.listdir(log_dir))
+            return 200, {"logs": [
+                {"name": n,
+                 "size_bytes": os.path.getsize(os.path.join(log_dir, n))}
+                for n in files
+            ]}
+        name = parts[2]
+        # the filename comes off the URL: never let it traverse out
+        target = os.path.realpath(os.path.join(log_dir, name))
+        if (os.path.dirname(target) != os.path.realpath(log_dir)
+                or not os.path.isfile(target)):
+            return 404, {"error": f"no log file {name!r}"}
+        try:
+            tail = int(query.get("tail", "") or 200)
+        except ValueError:
+            return 400, {"error": "tail must be an integer"}
+        tail = max(0, min(tail, 100_000))
+        # bounded tail: never materialize a multi-GB log in memory
+        with open(target, "r", errors="replace") as f:
+            lines = deque(f, maxlen=tail)
+        return 200, {"name": name,
+                     "lines": [ln.rstrip("\n") for ln in lines]}
 
     def _index_html(self) -> bytes:
         """Single-page live dashboard: vanilla JS polling the /api routes

@@ -33,6 +33,12 @@ class ProxyActor:
         # threads, which would head-of-line-block cheap requests (and route
         # refreshes) behind slow ones.
         self._pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="proxy")
+        # Streams block a thread between item pulls (up to the whole
+        # response lifetime): give them their own pool so slow streams can
+        # never starve routing/non-streaming traffic out of self._pool.
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="proxy-stream")
+        self._stream_handles: dict = {}  # ingress name -> streaming handle
 
     async def start(self, port: int = 0) -> int:
         self._server = await asyncio.start_server(self._handle, "127.0.0.1", port)
@@ -155,8 +161,6 @@ class ProxyActor:
         (newline-delimited; JSON for non-str/bytes items). The first item is
         pulled BEFORE committing the status line, so an immediately-failing
         generator still gets a 500 like the non-streaming path."""
-        if not hasattr(self, "_stream_handles"):
-            self._stream_handles = {}
         # cached per ingress: a fresh handle per request would re-fetch
         # replicas from the controller and reset the p2c in-flight view
         h = self._stream_handles.get(handle.deployment_name)
@@ -183,7 +187,8 @@ class ProxyActor:
                 return _END
 
         try:
-            item = await loop.run_in_executor(self._pool, _start_and_first)
+            item = await loop.run_in_executor(
+                self._stream_pool, _start_and_first)
         except Exception as e:
             await self._respond(
                 writer, 500, json.dumps({"error": str(e)}).encode())
@@ -205,7 +210,7 @@ class ProxyActor:
                 data += b"\n"
                 writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                 await writer.drain()
-                item = await loop.run_in_executor(self._pool, _next)
+                item = await loop.run_in_executor(self._stream_pool, _next)
             writer.write(b"0\r\n\r\n")
             await writer.drain()
         except Exception:

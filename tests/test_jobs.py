@@ -13,6 +13,7 @@ import os
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -153,3 +154,27 @@ def test_cli(job_cluster, tmp_path):
     assert st.stdout.strip() == "SUCCEEDED"
     logs = cli("job", "--address", job_cluster, "logs", sid)
     assert "42" in logs.stdout
+
+
+def test_dashboard_logs_api(job_cluster):
+    """Log module (reference: dashboard/modules/log/): list + tail session
+    log files over HTTP; path traversal is rejected."""
+    from ray_tpu.dashboard import start_dashboard
+
+    _, port = start_dashboard(job_cluster)
+    base = f"http://127.0.0.1:{port}"
+    with urllib.request.urlopen(f"{base}/api/logs", timeout=30) as r:
+        logs = json.loads(r.read())["logs"]
+    assert logs, "session log dir should contain process logs"
+    name = next(l["name"] for l in logs if l["size_bytes"] > 0)
+    with urllib.request.urlopen(f"{base}/api/logs/{name}?tail=5",
+                                timeout=30) as r:
+        payload = json.loads(r.read())
+    assert payload["name"] == name
+    assert len(payload["lines"]) <= 5
+    # traversal attempt 404s
+    try:
+        urllib.request.urlopen(f"{base}/api/logs/..%2Fgcs.log", timeout=30)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
