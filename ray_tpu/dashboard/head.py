@@ -319,8 +319,9 @@ _INDEX_HTML = b"""<!doctype html>
 &middot; refreshes every 2s</section>
 <script>
 async function j(p){const r=await fetch(p);return r.json()}
+function esc(s){return String(s).replace(/[&<>"']/g,c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
 function row(cells,h){return '<tr>'+cells.map(c=>(h?'<th>':'<td>')+c+(h?'</th>':'</td>')).join('')+'</tr>'}
-function st(s){return '<span class="'+s+'">'+s+'</span>'}
+function st(s){return '<span class="'+esc(s)+'">'+esc(s)+'</span>'}
 function fmtRes(r){return Object.entries(r||{}).map(([k,v])=>k+':'+(typeof v=='number'?Math.round(v*10)/10:v)).join(' ')}
 async function tick(){
  try{
@@ -336,13 +337,13 @@ async function tick(){
     ['TPU',Math.round(((total.TPU||0)-(avail.TPU||0))*10)/10+' / '+(total.TPU||0)]]
    .map(([k,v])=>'<div class=tile><div class=v>'+v+'</div><div class=k>'+k+'</div></div>').join('');
   document.getElementById('nodes').innerHTML=row(['node','state','ip','total','available'],1)+
-   nodes.map(n=>row([n.node_id.slice(0,12),st(n.state),n.node_ip,fmtRes(n.resources_total),fmtRes(n.resources_available)])).join('');
+   nodes.map(n=>row([esc(n.node_id.slice(0,12)),st(n.state),esc(n.node_ip),esc(fmtRes(n.resources_total)),esc(fmtRes(n.resources_available))])).join('');
   document.getElementById('actors').innerHTML=row(['actor','class','name','state','node','restarts'],1)+
-   actors.slice(0,200).map(a=>row([a.actor_id.slice(0,12),a.class_name||'',a.name||'',st(a.state),(a.node_id||'').slice(0,12),a.num_restarts||0])).join('');
+   actors.slice(0,200).map(a=>row([esc(a.actor_id.slice(0,12)),esc(a.class_name||''),esc(a.name||''),st(a.state),esc((a.node_id||'').slice(0,12)),a.num_restarts||0])).join('');
   document.getElementById('jobs').innerHTML=row(['job','entrypoint','status','start'],1)+
-   jobs.map(x=>row([x.job_id||x.submission_id||'',(x.entrypoint||'').slice(0,80),st(x.status||x.state||''),x.start_time?new Date(x.start_time*1000).toLocaleTimeString():''])).join('');
+   jobs.map(x=>row([esc(x.job_id||x.submission_id||''),esc((x.entrypoint||'').slice(0,80)),st(x.status||x.state||''),x.start_time?new Date(x.start_time*1000).toLocaleTimeString():''])).join('');
   document.getElementById('pgs').innerHTML=row(['pg','name','strategy','state','bundles'],1)+
-   pgs.map(p=>row([p.placement_group_id.slice(0,12),p.name||'',p.strategy,st(p.state),p.bundles.length])).join('');
+   pgs.map(p=>row([esc(p.placement_group_id.slice(0,12)),esc(p.name||''),esc(p.strategy),st(p.state),p.bundles.length])).join('');
   document.getElementById('err').textContent='';
  }catch(e){document.getElementById('err').textContent='api error: '+e}
 }

@@ -224,6 +224,13 @@ class Dataset:
                 order = order[::-1]
             out: Block = {k: np.asarray(v)[order] for k, v in whole.items()}
         else:
+            if key is None and whole and isinstance(whole[0], dict):
+                key = next(iter(whole[0]))  # match columnar default
+            if isinstance(key, str):
+                # row-oriented blocks: a string key selects the column
+                import operator
+
+                key = operator.itemgetter(key)
             out = sorted(whole, key=key, reverse=descending)
         return Dataset([ray_tpu.put(out)])
 

@@ -39,7 +39,10 @@ def numpy_forward(params, obs: np.ndarray):
     device runtime (reference: env runners hold a lightweight policy copy).
     Mirrors ActorCriticModule's architecture exactly."""
     x = obs.astype(np.float32)
-    layers = sorted(k for k in params if k.startswith("Dense_"))
+    # numeric sort: flax auto-names are Dense_0..Dense_N and 'Dense_10'
+    # sorts lexicographically before 'Dense_2'
+    layers = sorted((k for k in params if k.startswith("Dense_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
     for k in layers:
         x = np.tanh(x @ np.asarray(params[k]["kernel"])
                     + np.asarray(params[k]["bias"]))
@@ -72,7 +75,8 @@ class QModule(nn.Module):
 def numpy_q_forward(params, obs: np.ndarray):
     """Numpy mirror of QModule for CPU env runners (relu hidden stack)."""
     x = obs.astype(np.float32)
-    layers = sorted(k for k in params if k.startswith("Dense_"))
+    layers = sorted((k for k in params if k.startswith("Dense_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
     for k in layers:
         x = np.maximum(
             x @ np.asarray(params[k]["kernel"]) + np.asarray(params[k]["bias"]),

@@ -61,11 +61,17 @@ class _Session:
         # every step costs ~nothing relative to the compiled step.
         self.pipeline_depth = max(1, pipeline_depth)
         self._slot = threading.Semaphore(self.pipeline_depth)
+        self._ack_cond = threading.Condition()
+        self._submitted = 0
+        self._acked = 0
         self.finished = False
         self.error: Optional[BaseException] = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint]):
         self._slot.acquire()  # wait for a free pipeline slot
+        with self._ack_cond:
+            seq = self._submitted
+            self._submitted += 1
         self.consumed.clear()
         self.reports.put({"metrics": metrics, "checkpoint": checkpoint})
         if self.pipeline_depth == 1:
@@ -73,9 +79,22 @@ class _Session:
             # report — Tune trial loops rely on it (a checkpoint dir may be
             # reused right after report() returns)
             self.consumed.wait()
+        elif checkpoint is not None:
+            # Reference semantics (train/_internal/session.py report :667):
+            # the checkpoint is persisted before report() returns, so the
+            # user may delete or reuse the dir immediately after. Block
+            # until the driver acked THIS report (acks are released only
+            # after _consume_round copied/uploaded the dir). Metrics-only
+            # reports keep the deep pipeline.
+            with self._ack_cond:
+                while self._acked <= seq:
+                    self._ack_cond.wait()
 
     def ack(self, n: int = 1):
         self.consumed.set()
+        with self._ack_cond:
+            self._acked += n
+            self._ack_cond.notify_all()
         for _ in range(n):
             self._slot.release()
 

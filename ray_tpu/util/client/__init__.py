@@ -86,15 +86,26 @@ class ClientRemoteFunction:
         self._fn = fn
         self._ctx = ctx
         self._opts = opts or {}
-        # stable cache key so the server deserializes the function once
-        self._fn_id = f"{id(ctx)}:{id(fn)}".encode()
+        # Cache key = content digest of the pickled function (as the
+        # reference client does): id()-based keys alias after GC, making
+        # the server silently run a stale cached function.
+        self._fn_bytes: Optional[bytes] = None
+        self._fn_id: Optional[bytes] = None
 
     def options(self, **opts) -> "ClientRemoteFunction":
         merged = {**self._opts, **opts}
-        return ClientRemoteFunction(self._fn, self._ctx, merged)
+        out = ClientRemoteFunction(self._fn, self._ctx, merged)
+        out._fn_bytes, out._fn_id = self._fn_bytes, self._fn_id
+        return out
 
     def remote(self, *args, **kwargs) -> ClientObjectRef:
-        return self._ctx._task(self._fn, self._fn_id, self._opts, args, kwargs)
+        if self._fn_id is None:
+            import hashlib
+
+            self._fn_bytes = self._ctx._dumps(self._fn)
+            self._fn_id = hashlib.sha256(self._fn_bytes).hexdigest().encode()
+        return self._ctx._task(self._fn_bytes, self._fn_id, self._opts,
+                               args, kwargs)
 
 
 class ClientActorClass:
@@ -211,9 +222,9 @@ class ClientContext:
         return ([by_id[i] for i in r["ready"]],
                 [by_id[i] for i in r["pending"]])
 
-    def _task(self, fn, fn_id, opts, args, kwargs) -> ClientObjectRef:
+    def _task(self, fn_bytes, fn_id, opts, args, kwargs) -> ClientObjectRef:
         r = self._call("client_task", {
-            "fn": self._dumps(fn),
+            "fn": fn_bytes,
             "fn_id": fn_id,
             "opts": opts,
             "args": self._dumps((list(args), kwargs)),

@@ -202,3 +202,39 @@ def test_group_restart_on_failure(ray_4cpu):
     )
     result = trainer.fit()
     assert result.metrics["step"] == 3
+
+
+def test_pipelined_checkpoint_report_blocks_until_ack():
+    """With pipeline_depth > 1, a checkpoint report must not return before
+    the driver acked it (the checkpoint dir may be deleted right after
+    report() returns — reference train/_internal/session.py:667 persists
+    before returning). Metrics-only reports stay pipelined."""
+    import threading
+    import time
+
+    from ray_tpu.train._session import TrainContext, _Session
+
+    ctx = TrainContext(0, 1, 0, 1, "127.0.0.1")
+    s = _Session(ctx, None, pipeline_depth=8)
+
+    # metrics-only reports return immediately (no ack yet)
+    for i in range(4):
+        s.report({"step": i}, None)
+
+    state = {"returned": False}
+
+    def ckpt_report():
+        s.report({"step": 4}, Checkpoint("/tmp"))
+        state["returned"] = True
+
+    t = threading.Thread(target=ckpt_report, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not state["returned"], "checkpoint report returned before ack"
+    # driver consumes+acks the first 4 rounds: still not this report's turn
+    s.ack(4)
+    time.sleep(0.2)
+    assert not state["returned"]
+    s.ack(1)  # ack the checkpoint round itself
+    t.join(timeout=5)
+    assert state["returned"]
