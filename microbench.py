@@ -18,21 +18,35 @@ import time
 import numpy as np
 
 
-def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0):
-    """Run fn repeatedly for >= min_time; return ops/s (fn does `multiplier`
-    ops per call). Mirrors ray_perf.timeit."""
+_REPS = 1  # set by run_benches: 3 for the committed table, 1 for quick
+
+
+def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0, reps=None):
+    """Run fn repeatedly for >= min_time, `reps` times back-to-back in the
+    same process state; report the MEDIAN rep's ops/s. Mirrors
+    ray_perf.timeit plus a pinned repetition protocol — single runs on this
+    box swing ±25-30%, so regressions would otherwise hide in noise."""
+    if reps is None:
+        reps = _REPS
     for _ in range(warmup):
         fn()
-    count = 0
-    t0 = time.perf_counter()
-    while True:
-        fn()
-        count += 1
-        dt = time.perf_counter() - t0
-        if dt >= min_time:
-            break
-    rate = count * multiplier / dt
-    print(f"  {name}: {rate:,.1f} /s")
+    rates = []
+    for _ in range(reps):
+        count = 0
+        t0 = time.perf_counter()
+        while True:
+            fn()
+            count += 1
+            dt = time.perf_counter() - t0
+            if dt >= min_time:
+                break
+        rates.append(count * multiplier / dt)
+    rates.sort()
+    rate = rates[len(rates) // 2]
+    spread = (
+        f"  (min {min(rates):,.0f} max {max(rates):,.0f})" if reps > 1 else ""
+    )
+    print(f"  {name}: {rate:,.1f} /s{spread}")
     return rate
 
 
@@ -82,10 +96,12 @@ def run_benches(quick: bool = False) -> dict:
     import ray_tpu
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
+    global _REPS
     small_task, Actor, AsyncActor, Client = _define_remotes()
     results = {}
     min_time = 0.5 if quick else 2.0
     batch = 100 if quick else 1000
+    _REPS = 1 if quick else 3
 
     ray_tpu.init(num_cpus=8)
     try:
@@ -99,6 +115,34 @@ def run_benches(quick: bool = False) -> dict:
             "single client tasks async",
             lambda: ray_tpu.get([small_task.remote() for _ in range(batch)]),
             multiplier=batch, min_time=min_time)
+
+        # wait() at 1k-ref scale (reference: release/benchmarks single-node
+        # ray.get/wait batch limits)
+        wait_n = 200 if quick else 1000
+
+        def wait_cycle():
+            refs = [small_task.remote() for _ in range(wait_n)]
+            ready, _ = ray_tpu.wait(refs, num_returns=wait_n, timeout=60)
+            assert len(ready) == wait_n
+
+        results["wait_1k_refs"] = timeit(
+            "wait on 1k refs", wait_cycle, multiplier=wait_n,
+            min_time=min_time)
+
+        # multi-client task submission: n driver-like client actors each
+        # submitting async task batches (ray_perf multi_client_tasks_async)
+        n_cli = 2 if quick else 4
+        per_cli = 50 if quick else 200
+        task_clients = [Client.remote([]) for _ in range(n_cli)]
+        ray_tpu.get([c.task_batch.remote(1) for c in task_clients])
+        results["multi_client_tasks_async"] = timeit(
+            "multi client tasks async",
+            lambda: ray_tpu.get(
+                [c.task_batch.remote(per_cli) for c in task_clients]
+            ),
+            multiplier=n_cli * per_cli, min_time=min_time)
+        for c in task_clients:
+            ray_tpu.kill(c)
 
         # actor calls
         a = Actor.remote()
@@ -179,6 +223,7 @@ def run_quick() -> dict:
 BASELINE = {
     "single_client_tasks_sync": 1046,
     "single_client_tasks_async": 8051,
+    "multi_client_tasks_async": 24773,
     "1_1_actor_calls_sync": 2050,
     "1_1_actor_calls_async": 8719,
     "n_n_actor_calls_async": 28466,
@@ -193,12 +238,21 @@ def main():
         "# Microbenchmarks (ray_perf port)",
         "",
         "Run on this machine's CPU control plane via `python microbench.py`.",
-        "Reference numbers from BASELINE.md (release rig, m5.16xlarge) —",
-        "absolute cross-machine comparisons are rough. Context: this box's",
-        "raw shared-memory write bandwidth measures 2.1 GiB/s (page-fault",
-        "bound), so ~1.4 GiB/s through the full put path is ~65% of the",
-        "hardware ceiling here. Numbers vary ±25% run to run with process",
-        "warm-up (PG cycle measured 268-555/s across trials in one process).",
+        "Protocol: each metric runs 3 back-to-back timing reps (>=2 s each)",
+        "in the same process state; the table records the MEDIAN rep",
+        "(single runs swing ±25-30% on this box).",
+        "",
+        "Context for the ratios: this box has ONE CPU core (`nproc` = 1);",
+        "the reference numbers come from a 64-vCPU m5.16xlarge. The",
+        "multi-process benches (multi_client, n:n) cannot exceed the",
+        "single-stream aggregate here — every client/server process shares",
+        "the core — so their ratios understate the design by the core",
+        "count. Single-stream metrics are the honest comparison. Raw",
+        "shared-memory write bandwidth measures 2.1 GiB/s on this box",
+        "(page-fault bound), bounding the put path.",
+        "",
+        "See PROFILE.md for where the submit/push hot-path time goes and",
+        "what round 3 changed.",
         "",
         "| metric | ray_tpu | reference | ratio |",
         "|---|---|---|---|",

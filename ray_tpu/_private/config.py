@@ -41,6 +41,14 @@ _FLAGS: Dict[str, Any] = {
     "task_push_max_batch": 16,
     # Cap on concurrent RequestWorkerLease RPCs per scheduling key.
     "max_lease_requests_in_flight": 16,
+    # Actor-task pushes pipeline up to this many batch RPCs per actor
+    # (reference: actor_task_submitter.h pushes without waiting for prior
+    # replies; the receiver's seq_no reorder buffer restores order).
+    "actor_push_max_inflight": 4,
+    # Thread cap of the persistent pool serving batched normal-task
+    # execution (tasks in one batch may synchronize with each other, so
+    # each needs its own thread while running).
+    "batch_exec_max_threads": 256,
     # How long a PG-bound task waits for its group's 2PC to finish before failing.
     "placement_group_ready_timeout_s": 60.0,
     # Max idle workers kept alive per node (soft cap, like num_cpus in reference).

@@ -56,6 +56,12 @@ class FunctionManager:
             pass
         return key
 
+    def fetch_cached(self, key: bytes) -> Any:
+        """Non-blocking cache probe; None on miss (callers then fetch() off
+        the io loop — the KV round-trip blocks)."""
+        with self._lock:
+            return self._cache.get(key)
+
     def fetch(self, key: bytes) -> Any:
         with self._lock:
             if key in self._cache:

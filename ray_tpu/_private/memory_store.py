@@ -15,9 +15,33 @@ from ray_tpu._private.ids import ObjectID
 
 
 class _Pending:
-    __slots__ = ("event",)
+    """Placeholder for an expected value. The event is lazy (most objects
+    are put before anyone waits) and batch waiters let a 1000-ref get()
+    block on ONE event instead of 1000 (each wait_for costs a Task + timer
+    on the loop)."""
+
+    __slots__ = ("event", "waiters")
 
     def __init__(self):
+        self.event = None
+        self.waiters = None
+
+    def resolve(self):
+        if self.event is not None:
+            self.event.set()
+        if self.waiters:
+            for w in self.waiters:
+                w.remaining -= 1
+                if w.remaining <= 0:
+                    w.event.set()
+            self.waiters = None
+
+
+class _BatchWaiter:
+    __slots__ = ("remaining", "event")
+
+    def __init__(self):
+        self.remaining = 0
         self.event = asyncio.Event()
 
 
@@ -47,7 +71,7 @@ class MemoryStore:
         self._store[object_id] = value
         p = self._pending.pop(object_id, None)
         if p is not None:
-            p.event.set()
+            p.resolve()
 
     def contains(self, object_id: ObjectID) -> bool:
         return object_id in self._store
@@ -66,17 +90,57 @@ class MemoryStore:
         if p is None:
             # Not pending and not present: either never created here or already freed.
             return object_id in self._store
+        if p.event is None:
+            p.event = asyncio.Event()
+        if timeout is None:
+            await p.event.wait()
+            return True
         try:
             await asyncio.wait_for(p.event.wait(), timeout)
             return True
         except asyncio.TimeoutError:
             return False
 
+    async def wait_ready_many(self, object_ids, timeout: Optional[float] = None) -> bool:
+        """Wait until ALL given objects resolve (value, placeholder, or
+        free). One event for the whole batch. False on timeout."""
+        w = _BatchWaiter()
+        registered = []
+        for oid in object_ids:
+            if oid in self._store:
+                continue
+            p = self._pending.get(oid)
+            if p is None:
+                continue
+            if p.waiters is None:
+                p.waiters = []
+            p.waiters.append(w)
+            registered.append(p)
+            w.remaining += 1
+        if w.remaining <= 0:
+            return True
+        if timeout is None:
+            await w.event.wait()
+            return True
+        try:
+            await asyncio.wait_for(w.event.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            # Deregister, or a get()-with-timeout polling loop accumulates
+            # a stale waiter per call on every still-pending object.
+            for p in registered:
+                if p.waiters is not None:
+                    try:
+                        p.waiters.remove(w)
+                    except ValueError:
+                        pass
+            return False
+
     def free(self, object_id: ObjectID):
         self._store.pop(object_id, None)
         p = self._pending.pop(object_id, None)
         if p is not None:
-            p.event.set()
+            p.resolve()
 
     def fail_pending(self, object_id: ObjectID, error: Exception):
         """Resolve a pending object to an error value (task failure, etc.)."""

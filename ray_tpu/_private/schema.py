@@ -119,6 +119,8 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "PushTasks": {"specs": list},
     "CreateActor": {"spec": dict, "actor_id": bytes},
     "PushActorTask": {"spec": dict},
+    "PushActorTasks": {"specs": list, "reply_addr": _addr},
+    "ActorTaskReplies": {"replies": list},
     "GetObjectStatus": {"object_id": bytes, "wait?": bool,
                         "timeout?": (_num, type(None))},
     "AddBorrowerRef": {"object_id": bytes, "borrower": _addr},

@@ -70,10 +70,49 @@ class _Unpickler(pickle.Unpickler):
         return ref
 
 
+_PLAIN = (bytes, bytearray, str, int, float, bool, type(None))
+
+
+def _fast_safe(value, depth: int = 3) -> bool:
+    """True if value is a composition of plain types the C pickler handles
+    identically to cloudpickle (no functions/classes/refs — those need
+    by-value pickling or persistent ids). Exact type checks: subclasses may
+    carry custom __reduce__."""
+    t = type(value)
+    if t in _PLAIN:
+        return True
+    if t.__module__ == "numpy":
+        import numpy as np
+
+        if t is np.ndarray:
+            # hasobject also catches object fields nested in structured
+            # dtypes, which plain `dtype != object` misses
+            return not value.dtype.hasobject
+        return isinstance(value, np.generic)  # numpy scalar
+    if depth:
+        if t in (list, tuple, set):
+            return all(_fast_safe(v, depth - 1) for v in value)
+        if t is dict:
+            return all(
+                type(k) in _PLAIN and _fast_safe(v, depth - 1)
+                for k, v in value.items()
+            )
+    return False
+
+
 def serialize(value: Any) -> Tuple[bytes, List, List[ObjectRef]]:
     """Returns (pickle_bytes, buffers, contained_refs)."""
     value = _to_host(value)
     buffers: List = []
+    if _fast_safe(value):
+        # C pickler: ~20x faster than the pure-Python CloudPickler for the
+        # small control-plane payloads that dominate task/actor-call rates;
+        # protocol-5 buffer_callback still gives zero-copy numpy.
+        return (
+            pickle.dumps(value, protocol=5, buffer_callback=buffers.append),
+            buffers,
+            [],
+        )
     refs: List[ObjectRef] = []
     f = io.BytesIO()
     _Pickler(f, buffers, refs).dump(value)

@@ -104,22 +104,29 @@ class TaskEventBuffer:
         self.core = core
         self._events: List[dict] = []
         self._lock = threading.Lock()
+        # record() runs twice per task on the hot path — snapshot what never
+        # changes for this worker's lifetime
+        self._max_buffer = RTPU_CONFIG.task_events_max_buffer
+        self._worker_hex = core.worker_id.hex()
+        self._node_hex = ""
 
     def record(self, spec: dict, state: str, error: str = ""):
+        if not self._node_hex and self.core.node_id:
+            self._node_hex = self.core.node_id.hex()
         ev = {
             "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes) else spec["task_id"],
             "name": spec.get("name", ""),
             "job_id": spec.get("job_id", b"").hex() if isinstance(spec.get("job_id"), bytes) else "",
             "state": state,
             "ts": time.time(),
-            "node_id": self.core.node_id.hex() if self.core.node_id else "",
-            "worker_id": self.core.worker_id.hex(),
+            "node_id": self._node_hex,
+            "worker_id": self._worker_hex,
             "error": error,
             "actor_id": spec.get("actor_id", b"").hex() if spec.get("actor_id") else "",
         }
         with self._lock:
             self._events.append(ev)
-            if len(self._events) > RTPU_CONFIG.task_events_max_buffer:
+            if len(self._events) > self._max_buffer:
                 del self._events[: len(self._events) // 2]
 
     def record_span(
@@ -167,7 +174,7 @@ class _LeaseState:
 class _ActorSubmitter:
     __slots__ = (
         "actor_id", "state", "addr", "seq", "buffer", "inflight", "watched",
-        "death_cause", "creation_refs",
+        "death_cause", "creation_refs", "push_queue", "pushing", "epoch",
     )
 
     def __init__(self, actor_id: bytes):
@@ -176,6 +183,9 @@ class _ActorSubmitter:
         self.addr: Optional[Tuple[str, int]] = None
         self.seq = 0
         self.buffer: deque = deque()  # specs waiting for ALIVE
+        self.push_queue: deque = deque()  # specs ready to push (actor ALIVE)
+        self.pushing = 0  # in-flight push batches awaiting their replies
+        self.epoch = 0  # bumped on restart; stale batch accounting ignores
         self.inflight: Dict[bytes, dict] = {}  # task_id -> spec
         self.watched = False
         self.death_cause = ""
@@ -199,6 +209,11 @@ class CoreWorker:
         self.session_dir = session_dir
         self.io = IoThread.current()
         self.inline_threshold = RTPU_CONFIG.max_direct_call_object_size
+        # hot-path config snapshot (each RTPU_CONFIG read is an os.environ
+        # probe, ~12 µs — these are read multiple times per task)
+        self._cfg_push_batch = RTPU_CONFIG.task_push_max_batch
+        self._cfg_lease_inflight = RTPU_CONFIG.max_lease_requests_in_flight
+        self._cfg_actor_inflight = RTPU_CONFIG.actor_push_max_inflight
 
         self.server = RpcServer(host)
         from ray_tpu._private import schema as _schema
@@ -234,6 +249,18 @@ class CoreWorker:
         self._pg_node_cache: Dict[tuple, bytes] = {}  # (pg_id, idx) -> node_id
         self._lineage: Dict[bytes, dict] = {}  # task_id -> spec (for reconstruction)
         self._lineage_bytes = 0
+
+        # Batched thread->loop handoff: submits/frees/notifies append here
+        # and wake the io loop once per burst (a call_soon_threadsafe each
+        # costs ~0.1 ms of self-pipe + GIL churn; per-task wakeups capped
+        # submission at ~3k tasks/s — reference analogue: the Cython layer
+        # posts into the asio io_service without a per-call thread switch).
+        self._loop_work: deque = deque()
+        self._loop_work_lock = threading.Lock()
+        self._loop_work_scheduled = False
+        # executor-side reply streaming for batched actor-task pushes
+        self._reply_bufs: Dict[tuple, list] = {}
+        self._reply_flush_scheduled: set = set()
 
         # task context for the executing thread
         self._ctx = threading.local()
@@ -376,18 +403,63 @@ class CoreWorker:
                     {"object_id": oid.binary(), "borrower": list(self.address)},
                 )
 
-    def _post_owner_notify(self, owner_addr, method, payload):
-        async def go():
-            try:
-                client = await self.pool.get(owner_addr[0], owner_addr[1])
-                await client.notify(method, payload)
-            except Exception:
-                pass
-
+    def _post_batched(self, kind: str, item):
+        """Queue loop-side work from a foreign thread with one io-loop
+        wakeup per burst instead of one run_coroutine_threadsafe per call."""
+        with self._loop_work_lock:
+            self._loop_work.append((kind, item))
+            if self._loop_work_scheduled:
+                return
+            self._loop_work_scheduled = True
         try:
-            self.io.post(go())
+            self.io.loop.call_soon_threadsafe(self._drain_loop_work)
+        except RuntimeError:
+            pass  # loop closed (shutdown)
+
+    def _drain_loop_work(self):
+        """Runs on the io loop: route every queued item, then kick each
+        touched pump exactly once."""
+        with self._loop_work_lock:
+            work = self._loop_work
+            self._loop_work = deque()
+            self._loop_work_scheduled = False
+        normal_states: Dict[tuple, _LeaseState] = {}
+        actor_subs: Dict[bytes, _ActorSubmitter] = {}
+        frees: list = []
+        for kind, item in work:
+            if kind == "normal":
+                key = ts.scheduling_key(item)
+                state = self._leases.setdefault(key, _LeaseState())
+                state.queue.append(item)
+                normal_states[key] = state
+            elif kind == "actor":
+                actor_id, spec = item
+                sub = self._route_actor_spec(actor_id, spec)
+                if sub is not None:
+                    actor_subs[actor_id] = sub
+            elif kind == "free":
+                frees.append(item)
+            else:  # notify
+                owner_addr, method, payload = item
+                asyncio.ensure_future(
+                    self._notify_owner(owner_addr, method, payload)
+                )
+        for key, state in normal_states.items():
+            asyncio.ensure_future(self._pump_leases(key, state))
+        for sub in actor_subs.values():
+            self._pump_actor(sub)
+        if frees:
+            asyncio.ensure_future(self._free_refs_batch(frees))
+
+    async def _notify_owner(self, owner_addr, method, payload):
+        try:
+            client = await self.pool.get(owner_addr[0], owner_addr[1])
+            await client.notify(method, payload)
         except Exception:
             pass
+
+    def _post_owner_notify(self, owner_addr, method, payload):
+        self._post_batched("notify", (owner_addr, method, payload))
 
     def as_future(self, ref: ObjectRef):
         import concurrent.futures
@@ -413,29 +485,27 @@ class CoreWorker:
 
     def _on_ref_zero(self, oid: ObjectID):
         """Owned object's refcount hit zero: free it everywhere."""
+        self._post_batched("free", oid)
 
-        async def free():
+    async def _free_refs_batch(self, oids):
+        """Free a burst of dead objects: local stores synchronously, then
+        one FreeObjects notify per holding node for the whole batch."""
+        by_node: Dict[bytes, list] = {}
+        for oid in oids:
             entry = self.memory_store.get_if_exists(oid)
             self.memory_store.free(oid)
             locations = self._object_locations.pop(oid.binary(), set())
             if isinstance(entry, InPlasma):
                 locations |= entry.locations
-            if locations:
-                await self._free_plasma_copies(oid, locations)
-
-        try:
-            self.io.post(free())
-        except Exception:
-            pass
-
-    async def _free_plasma_copies(self, oid: ObjectID, locations):
-        for node_id in locations:
+            for node_id in locations:
+                by_node.setdefault(node_id, []).append(oid.binary())
+        for node_id, ids in by_node.items():
             info = await self._node_info(node_id)
             if info is None:
                 continue
             try:
                 client = await self.pool.get(info["ip"], info["raylet_port"])
-                await client.notify("FreeObjects", {"ids": [oid.binary()]})
+                await client.notify("FreeObjects", {"ids": ids})
             except Exception:
                 pass
 
@@ -580,8 +650,36 @@ class CoreWorker:
         return value
 
     async def _async_resolve_many(self, refs, deadline):
-        tasks = [self._async_resolve(r, deadline) for r in refs]
-        return await asyncio.gather(*tasks)
+        # One batch event covers every owned-pending ref (per-ref
+        # gather+wait_for costs a Task + timer + Event each, ~150 µs/ref on
+        # a 1000-ref get); only stragglers (borrowed, plasma, errors) take
+        # the per-ref coroutine path.
+        if len(refs) > 1:
+            pending = [
+                r.object_id() for r in refs
+                if self.memory_store.is_pending(r.object_id())
+            ]
+            if pending:
+                timeout = None if deadline is None else max(0.0, deadline - time.time())
+                await self.memory_store.wait_ready_many(pending, timeout)
+        results = [None] * len(refs)
+        slow = []
+        for i, r in enumerate(refs):
+            oid = r.object_id()
+            entry = self.memory_store.get_if_exists(oid)
+            if entry is not None and not isinstance(entry, InPlasma):
+                results[i] = (
+                    entry[:2] if entry[0] in (_INLINE, _ERR) else ("value", entry)
+                )
+            else:
+                slow.append(i)
+        if slow:
+            resolved = await asyncio.gather(
+                *(self._async_resolve(refs[i], deadline) for i in slow)
+            )
+            for i, res in zip(slow, resolved):
+                results[i] = res
+        return results
 
     async def _async_resolve(self, ref: ObjectRef, deadline) -> tuple:
         """Resolve a ref to ('inline'|'err', payload) | ('plasma_local', oid) on IO loop."""
@@ -888,7 +986,7 @@ class CoreWorker:
         if trace_ctx is not None:
             spec["trace_ctx"] = trace_ctx
         return_refs = self._register_pending(spec, refs)
-        self.io.post(self._submit_normal(spec))
+        self._post_batched("normal", spec)
         return return_refs
 
     def prepare_runtime_env(self, runtime_env: Optional[dict]) -> Optional[dict]:
@@ -972,7 +1070,7 @@ class CoreWorker:
         # in the raylet's waiter queue while costing an RPC each.
         need = min(
             len(state.queue) - state.requests_in_flight,
-            RTPU_CONFIG.max_lease_requests_in_flight - state.requests_in_flight,
+            self._cfg_lease_inflight - state.requests_in_flight,
         )
         for _ in range(need):
             state.requests_in_flight += 1
@@ -1125,7 +1223,7 @@ class CoreWorker:
         )
         extra = min(
             len(state.queue) // expected_workers,
-            RTPU_CONFIG.task_push_max_batch - 1,
+            self._cfg_push_batch - 1,
         )
         for _ in range(extra):
             if not state.queue:
@@ -1362,10 +1460,13 @@ class CoreWorker:
         if trace_ctx is not None:
             spec["trace_ctx"] = trace_ctx
         return_refs = self._register_pending(spec, refs)
-        self.io.post(self._submit_actor_task(actor_id, spec))
+        self._post_batched("actor", (actor_id, spec))
         return return_refs
 
-    async def _submit_actor_task(self, actor_id: bytes, spec: dict):
+    def _route_actor_spec(self, actor_id: bytes, spec: dict):
+        """Assign the per-actor sequence number and stage the spec for
+        pushing. Returns the submitter iff it needs a pump kick (runs on
+        the io loop, called from the batched drain)."""
         sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
         sub.seq += 1
         spec["seq_no"] = sub.seq
@@ -1373,47 +1474,131 @@ class CoreWorker:
             sub.watched = True
             asyncio.ensure_future(self._watch_actor(actor_id))
         if sub.state == "ALIVE" and sub.addr:
-            asyncio.ensure_future(self._push_actor_task(sub, spec))
-        elif sub.state == "DEAD":
+            sub.push_queue.append(spec)
+            return sub
+        if sub.state == "DEAD":
             self._fail_task(spec, ActorDiedError(actor_id, sub.death_cause or "actor is dead"))
-        else:
-            sub.buffer.append(spec)
-            if sub.state == "UNKNOWN":
-                asyncio.ensure_future(self._refresh_actor_state(sub))
+            return None
+        sub.buffer.append(spec)
+        if sub.state == "UNKNOWN":
+            asyncio.ensure_future(self._refresh_actor_state(sub))
+        return None
 
-    async def _push_actor_task(self, sub: _ActorSubmitter, spec: dict):
-        sub.inflight[spec["task_id"]] = spec
+    def _pump_actor(self, sub: _ActorSubmitter):
+        """Push staged specs as pipelined batch RPCs (reference:
+        actor_task_submitter.h pushes without waiting for prior replies;
+        the receiver's seq_no reorder buffer restores order). A shallow
+        queue ships single specs immediately; a burst coalesces into
+        PushActorTasks batches, which is what lifts small-call throughput —
+        the control plane is message-count-bound."""
+        if sub.state != "ALIVE" or not sub.addr:
+            return
+        max_batch = self._cfg_push_batch
+        while sub.push_queue and sub.pushing < self._cfg_actor_inflight:
+            batch = []
+            while sub.push_queue and len(batch) < max_batch:
+                batch.append(sub.push_queue.popleft())
+            sub.pushing += 1
+            asyncio.ensure_future(self._push_actor_batch(sub, batch))
+
+    async def _push_actor_batch(self, sub: _ActorSubmitter, batch: list):
+        # A restart resets sub.pushing to 0 and bumps the epoch; any stale
+        # decrement from this coroutine would drive it negative and void
+        # the in-flight cap, so every decrement checks the epoch it started
+        # under.
+        epoch0 = sub.epoch
+
+        def release_push_slot():
+            if sub.epoch == epoch0:
+                sub.pushing -= 1
+
+        for spec in batch:
+            sub.inflight[spec["task_id"]] = spec
         try:
-            try:
-                client = await self.pool.get(*sub.addr)
-            except (ConnectionLost, OSError):
-                # Connection never established: the task provably did not
-                # execute, so it is safe to buffer for the restarted actor.
-                sub.inflight.pop(spec["task_id"], None)
-                sub.buffer.appendleft(spec)
-                sub.state = "RESTARTING?"
-                asyncio.ensure_future(self._refresh_actor_state(sub))
-                return
-            self.task_events.record(spec, "SUBMITTED")
-            reply = await client.call("PushActorTask", {"spec": spec}, timeout=None)
+            client = await self.pool.get(*sub.addr)
         except (ConnectionLost, OSError):
-            # Actor worker died with this task dispatched. The task may have
-            # already executed (e.g. it IS the task that killed the actor),
-            # so replaying it after restart would double-execute — fail it
-            # instead, matching the reference's actor_task_submitter
-            # semantics (max_task_retries defaults to 0).
-            sub.state = "RESTARTING?"
-            self._fail_task(
-                spec,
-                ActorDiedError(
-                    sub.actor_id, "actor died while this task was in flight"
-                ),
+            # Connection never established: the tasks provably did not
+            # execute, so it is safe to buffer them for the restarted
+            # actor. Several pipelined batches can land here in any
+            # order — rebuild the buffer sorted by seq so the restarted
+            # executor's reorder window starts from the lowest seq.
+            release_push_slot()
+            for spec in batch:
+                sub.inflight.pop(spec["task_id"], None)
+            sub.buffer = deque(
+                sorted(
+                    list(batch) + list(sub.buffer),
+                    key=lambda s: s.get("seq_no", 0),
+                )
             )
+            sub.state = "RESTARTING?"
             asyncio.ensure_future(self._refresh_actor_state(sub))
             return
-        finally:
+        for spec in batch:
+            self.task_events.record(spec, "SUBMITTED")
+        if len(batch) == 1:
+            # single-task fast path: reply rides the RPC response
+            spec = batch[0]
+            try:
+                reply = await client.call(
+                    "PushActorTask", {"spec": spec}, timeout=None
+                )
+            except (ConnectionLost, OSError):
+                # Actor worker died with this task dispatched. It may have
+                # already executed (e.g. it IS the task that killed the
+                # actor), so replaying after restart would double-execute —
+                # fail it instead, matching the reference's
+                # actor_task_submitter semantics (max_task_retries
+                # defaults to 0).
+                release_push_slot()
+                sub.inflight.pop(spec["task_id"], None)
+                sub.state = "RESTARTING?"
+                self._fail_task(
+                    spec,
+                    ActorDiedError(
+                        sub.actor_id, "actor died while this task was in flight"
+                    ),
+                )
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+                return
+            release_push_slot()
             sub.inflight.pop(spec["task_id"], None)
-        await self._process_task_reply(spec, reply)
+            await self._process_task_reply(spec, reply)
+            self._pump_actor(sub)
+            return
+        # Batched push: the receiver acks immediately and streams each
+        # task's reply back as it resolves (handle_ActorTaskReplies), so a
+        # slow task never holds a finished peer's reply. `pushing` stays
+        # held until every reply in the batch lands — that is the flow
+        # control bounding unreplied tasks per actor.
+        batch_state = {"remaining": len(batch), "sub": sub,
+                       "epoch": sub.epoch}
+        for spec in batch:
+            record = self._pending_tasks.get(spec["task_id"])
+            if record is not None:
+                record["push_batch"] = batch_state
+        try:
+            await client.call(
+                "PushActorTasks",
+                {"specs": batch, "reply_addr": list(self.address)},
+                timeout=None,
+            )
+        except (ConnectionLost, OSError):
+            sub.state = "RESTARTING?"
+            release_push_slot()
+            batch_state["epoch"] = -1  # stale: late replies must not double-count
+            for spec in batch:
+                sub.inflight.pop(spec["task_id"], None)
+                record = self._pending_tasks.get(spec["task_id"])
+                if record is not None:
+                    record.pop("push_batch", None)
+                self._fail_task(
+                    spec,
+                    ActorDiedError(
+                        sub.actor_id, "actor died while this task was in flight"
+                    ),
+                )
+            asyncio.ensure_future(self._refresh_actor_state(sub))
 
     async def _refresh_actor_state(self, sub: _ActorSubmitter):
         try:
@@ -1432,19 +1617,40 @@ class CoreWorker:
             sub.addr = new_addr
             sub.state = "ALIVE"
             if restarted:
-                sub.seq = sub.seq  # seq keeps increasing; receiver reorders from first seen
+                # seq keeps increasing; the fresh receiver reorders from the
+                # first seq it sees. Outstanding batch accounting belongs to
+                # the dead incarnation: invalidate it so late replies don't
+                # double-decrement.
+                sub.epoch += 1
+                sub.pushing = 0
             if hasattr(sub, "creation_refs"):
                 del sub.creation_refs
-            while sub.buffer:
-                spec = sub.buffer.popleft()
-                asyncio.ensure_future(self._push_actor_task(sub, spec))
+            if sub.buffer:
+                # Rebuffered (lower-seq) specs must precede anything staged
+                # while ALIVE: the fresh receiver's reorder window starts at
+                # the first seq it sees, so out-of-order delivery strands
+                # the lower seqs forever.
+                merged = sorted(
+                    list(sub.buffer) + list(sub.push_queue),
+                    key=lambda s: s.get("seq_no", 0),
+                )
+                sub.buffer.clear()
+                sub.push_queue = deque(merged)
+            self._pump_actor(sub)
         elif state == "DEAD":
             sub.state = "DEAD"
             sub.death_cause = rec.get("death_cause", "")
+            sub.epoch += 1
+            sub.pushing = 0
             err = ActorDiedError(sub.actor_id, f"actor died: {sub.death_cause}")
             while sub.buffer:
                 self._fail_task(sub.buffer.popleft(), err)
+            while sub.push_queue:
+                self._fail_task(sub.push_queue.popleft(), err)
             for spec in list(sub.inflight.values()):
+                record = self._pending_tasks.get(spec["task_id"])
+                if record is not None:
+                    record.pop("push_batch", None)
                 self._fail_task(spec, err)
             sub.inflight.clear()
         elif state in ("RESTARTING", "PENDING_CREATION"):
@@ -1619,19 +1825,24 @@ class CoreWorker:
         synchronize with each other (e.g. a barrier pair landing in one
         batch); with one thread each they behave exactly as if they'd been
         granted separate leases, which is the semantics batching must
-        preserve."""
-        from concurrent.futures import ThreadPoolExecutor
-
+        preserve. The executor's persistent elastic pool supplies the
+        threads (creating a pool per RPC cost ~0.1 ms/thread)."""
         specs = req["specs"]
-        pool = ThreadPoolExecutor(
-            max_workers=len(specs), thread_name_prefix="rtpu-batch"
-        )
+        pool = self.executor._batch_pool
+        # Preserve the old per-RPC-pool guarantee that every in-flight
+        # batched task owns a thread (tasks in a batch may synchronize with
+        # each other): grow the persistent pool's cap when concurrent
+        # batches would exhaust it. ThreadPoolExecutor only spawns threads
+        # on demand, so a high cap costs nothing until needed.
+        self.executor._batch_inflight += len(specs)
+        if self.executor._batch_inflight > pool._max_workers:
+            pool._max_workers = self.executor._batch_inflight + 16
         try:
             replies = await asyncio.gather(
                 *(self.executor._execute(spec, pool) for spec in specs)
             )
         finally:
-            pool.shutdown(wait=False)
+            self.executor._batch_inflight -= len(specs)
         return {"replies": list(replies)}
 
     async def handle_CreateActor(self, req):
@@ -1639,6 +1850,81 @@ class CoreWorker:
 
     async def handle_PushActorTask(self, req):
         return await self.executor.push_actor_task(req["spec"])
+
+    async def handle_PushActorTasks(self, req):
+        """Batched actor-task push: ack immediately, stream each task's
+        reply back to the owner as it resolves (batched notify frames).
+        One slow task in a batch never delays a finished peer's reply
+        (reference: per-call replies in core_worker.proto PushTask)."""
+        specs = req["specs"]
+        reply_addr = tuple(req["reply_addr"])
+        futs = self.executor.enqueue_actor_tasks(specs)
+        for spec, fut in zip(specs, futs):
+            task_id = spec["task_id"]
+            fut.add_done_callback(
+                lambda f, tid=task_id: self._queue_task_reply(
+                    reply_addr, tid, f
+                )
+            )
+        return {"accepted": len(specs)}
+
+    def _queue_task_reply(self, addr, task_id: bytes, fut):
+        """Buffer a resolved task reply for its owner; one in-flight flush
+        per destination burst (scheduled-drain, like _post_batched)."""
+        try:
+            reply = fut.result()
+        except Exception as e:  # executor-level failure
+            reply = {"status": "error", "error": str(e), "app_error": False}
+        buf = self._reply_bufs.setdefault(addr, [])
+        buf.append([task_id, reply])
+        if addr not in self._reply_flush_scheduled:
+            self._reply_flush_scheduled.add(addr)
+            asyncio.ensure_future(self._flush_task_replies(addr))
+
+    async def _flush_task_replies(self, addr):
+        try:
+            while True:
+                batch = self._reply_bufs.get(addr)
+                if not batch:
+                    return
+                self._reply_bufs[addr] = []
+                # A lost reply permanently hangs the owner's get() AND
+                # wedges its per-actor push window, so transient connect
+                # failures must retry; only an owner unreachable for ~15 s
+                # (presumed dead — nobody left to consume) drops them.
+                for attempt in range(6):
+                    try:
+                        client = await self.pool.get(addr[0], addr[1])
+                        await client.notify(
+                            "ActorTaskReplies", {"replies": batch}
+                        )
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.2 * (2 ** attempt))
+                else:
+                    self._reply_bufs.pop(addr, None)
+                    return
+        finally:
+            self._reply_flush_scheduled.discard(addr)
+
+    async def handle_ActorTaskReplies(self, req):
+        """Owner side: per-task replies streaming back from a batched
+        actor-task push."""
+        for task_id, reply in req["replies"]:
+            record = self._pending_tasks.get(task_id)
+            if record is None:
+                continue
+            spec = record["spec"]
+            batch_state = record.pop("push_batch", None)
+            await self._process_task_reply(spec, reply)
+            if batch_state is not None:
+                sub = batch_state["sub"]
+                sub.inflight.pop(task_id, None)
+                if batch_state["epoch"] == sub.epoch:
+                    batch_state["remaining"] -= 1
+                    if batch_state["remaining"] <= 0:
+                        sub.pushing -= 1
+                        self._pump_actor(sub)
 
     async def handle_GetObjectStatus(self, req):
         oid = ObjectID(req["object_id"])
