@@ -29,16 +29,21 @@ _ALIGN = 64
 
 
 def _to_host(value):
-    """Move a jax.Array to host memory as numpy (device buffers can't be pickled)."""
-    try:
-        import jax
+    """Move a jax.Array to host memory as numpy (device buffers can't be
+    pickled). Probes sys.modules instead of importing: if jax was never
+    imported in this process the value cannot be a jax array, and a cold
+    `import jax` costs ~2 s — a nasty surprise on a first put()/channel
+    write in a non-jax process."""
+    import sys
 
-        if isinstance(value, jax.Array):
-            import numpy as np
+    jax = sys.modules.get("jax")
+    # getattr guard: another thread may be mid-`import jax`, in which case
+    # sys.modules already holds a partially initialized module
+    jax_array = getattr(jax, "Array", None) if jax is not None else None
+    if jax_array is not None and isinstance(value, jax_array):
+        import numpy as np
 
-            return np.asarray(value)
-    except ImportError:
-        pass
+        return np.asarray(value)
     return value
 
 

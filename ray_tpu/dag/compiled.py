@@ -40,7 +40,11 @@ from ray_tpu.dag.node import (
 from ray_tpu.experimental.channel import (
     Channel,
     ChannelClosed,
+    SocketChannel,
     _PropagatedError,
+    attach_channel,
+    close_registered,
+    register_channel,
 )
 
 
@@ -69,9 +73,11 @@ def _exec_loop(self, tasks: List[dict]):
     attached: Dict[bytes, Channel] = {}
 
     def chan(desc, reader_index):
-        key = desc["oid"]
+        # keyed by reader slot too: two tasks on one actor consuming the
+        # same upstream own distinct slots and must ack independently
+        key = (desc.get("oid") or desc["token"], reader_index)
         if key not in attached:
-            attached[key] = Channel.attach(desc, reader_index)
+            attached[key] = attach_channel(desc, reader_index)
         return attached[key]
 
     try:
@@ -85,7 +91,7 @@ def _exec_loop(self, tasks: List[dict]):
                 args = []
                 error = None
                 for desc, ridx, unpack in t["reads"]:
-                    key = desc["oid"]
+                    key = desc.get("oid") or desc["token"]
                     if key in tick_cache:
                         v = tick_cache[key]
                     else:
@@ -96,7 +102,7 @@ def _exec_loop(self, tasks: List[dict]):
                         tick_cache[key] = v
                     if isinstance(v, _PropagatedError):
                         error = v
-                        args.append(None)
+                        args.append(None)  # placeholder; error short-circuits
                     elif unpack is None:
                         args.append(v)
                     else:
@@ -124,6 +130,42 @@ def _start_exec_loop(self, tasks: List[dict]):
         name="rtpu-dag-exec",
     )
     t.start()
+    return True
+
+
+def _get_node_id(self):
+    import ray_tpu
+
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def _remote_create_shm_channel(self, n_readers: int, buffer_size: int):
+    """Create a shared-memory channel in THIS actor's process (its node's
+    plasma) and register it for driver-directed teardown."""
+    from ray_tpu.experimental.channel import Channel, register_channel
+
+    ch = Channel.create(n_readers, buffer_size)
+    desc = ch.descriptor()
+    desc["token"] = desc["oid"]
+    register_channel(desc["token"], ch)
+    return desc
+
+
+def _remote_create_socket_channel(self, n_readers: int, buffer_size: int):
+    """Create a cross-node socket channel with THIS actor's process as the
+    writer end."""
+    from ray_tpu.experimental.channel import SocketChannel, register_channel
+
+    ch = SocketChannel.create(n_readers)
+    desc = ch.descriptor()
+    register_channel(desc["token"], ch)
+    return desc
+
+
+def _remote_close_channel(self, token: bytes):
+    from ray_tpu.experimental.channel import close_registered
+
+    close_registered(token)
     return True
 
 
@@ -205,18 +247,53 @@ class CompiledDAG:
         for n in outputs:
             consumers[id(n)].append("driver")
 
-        # Allocate channels.
-        self._input_channel = (
-            Channel.create(max(1, len(input_consumers)), self._buffer_size)
-            if input_consumers else None
-        )
-        node_channel: Dict[int, Channel] = {}
-        for n in order:
-            node_channel[id(n)] = Channel.create(
-                max(1, len(consumers[id(n)])), self._buffer_size
-            )
+        # Resolve actors and their nodes first: channel placement follows
+        # the node topology — a same-node edge rides shared memory, a
+        # cross-node edge rides a socket stream (the DCN hop; reference GPU
+        # analogue torch_tensor_nccl_channel.py:191).
+        import ray_tpu
 
-        # Build per-actor task descriptors.
+        my_node = ray_tpu.get_runtime_context().get_node_id()
+        handle_of: Dict[int, Any] = {}
+        for n in order:
+            handle_of[id(n)] = n._class_node._ensure_actor()
+        uniq_handles = {id(h): h for h in handle_of.values()}
+        node_refs = {
+            hid: h.__ray_call__.remote(_get_node_id)
+            for hid, h in uniq_handles.items()
+        }
+        node_of_handle = {hid: ray_tpu.get(r) for hid, r in node_refs.items()}
+        node_of = {
+            nid: node_of_handle[id(h)] for nid, h in handle_of.items()
+        }
+
+        self._local_channels: List[Any] = []
+        self._remote_tokens: List[tuple] = []  # (actor handle, token)
+
+        def make_channel(writer_nid, reader_nodes, n_readers):
+            """Allocate a channel in the writer's process. writer_nid is
+            id(node) for an actor writer, None for the driver."""
+            writer_node = my_node if writer_nid is None else node_of[writer_nid]
+            cross = any(rn != writer_node for rn in reader_nodes)
+            n_readers = max(1, n_readers)
+            if writer_nid is None:
+                ch = (SocketChannel.create(n_readers) if cross
+                      else Channel.create(n_readers, self._buffer_size))
+                desc = ch.descriptor()
+                if "token" not in desc:
+                    desc["token"] = desc["oid"]
+                self._local_channels.append(ch)
+                return ch, desc
+            h = handle_of[writer_nid]
+            fn = (_remote_create_socket_channel if cross
+                  else _remote_create_shm_channel)
+            desc = ray_tpu.get(
+                h.__ray_call__.remote(fn, n_readers, self._buffer_size)
+            )
+            self._remote_tokens.append((h, desc["token"]))
+            return None, desc
+
+        # Reader indices.
         input_rix: Dict[int, int] = {}
         for i, c in enumerate(input_consumers):
             input_rix.setdefault(id(c), i)
@@ -229,10 +306,30 @@ class CompiledDAG:
                 else:
                     node_rix[id(n)][id(c)] = i
 
+        # Allocate: the input channel is written by the driver; each node's
+        # output channel is written by its actor.
+        self._input_channel = None
+        input_desc = None
+        if input_consumers:
+            self._input_channel, input_desc = make_channel(
+                None, [node_of[id(c)] for c in input_consumers],
+                len(input_consumers),
+            )
+        node_desc: Dict[int, dict] = {}
+        for n in order:
+            reader_nodes = [
+                my_node if c == "driver" else node_of[id(c)]
+                for c in consumers[id(n)]
+            ]
+            _, node_desc[id(n)] = make_channel(
+                id(n), reader_nodes, len(consumers[id(n)])
+            )
+
+        # Build per-actor task descriptors.
         by_actor: Dict[Any, List[dict]] = {}
         self._actors = []
         for n in order:
-            handle = n._class_node._ensure_actor()
+            handle = handle_of[id(n)]
             reads = []
             static_args = []
             kwargs = {}
@@ -243,11 +340,10 @@ class CompiledDAG:
                     unpack = a._key
                     base = a._base
                 if isinstance(base, InputNode):
-                    reads.append((self._input_channel.descriptor(),
-                                  input_rix[id(n)], unpack))
+                    reads.append((input_desc, input_rix[id(n)], unpack))
                     static_args.append(_FROM_CHANNEL)
                 elif isinstance(base, ClassMethodNode):
-                    reads.append((node_channel[id(base)].descriptor(),
+                    reads.append((node_desc[id(base)],
                                   node_rix[id(base)][id(n)], unpack))
                     static_args.append(_FROM_CHANNEL)
                 else:
@@ -261,26 +357,8 @@ class CompiledDAG:
                 "reads": reads,
                 "static_args": static_args,
                 "kwargs": kwargs,
-                "write": node_channel[id(n)].descriptor(),
+                "write": node_desc[id(n)],
             })
-
-        # Same-node constraint: the shared-memory plane is node-local.
-        import ray_tpu
-
-        my_node = ray_tpu.get_runtime_context().get_node_id()
-        for handle in by_actor:
-            actor_node = ray_tpu.get(
-                handle.__ray_call__.remote(
-                    lambda self: __import__("ray_tpu")
-                    .get_runtime_context().get_node_id()
-                )
-            )
-            if actor_node != my_node:
-                raise ValueError(
-                    "compiled DAG actors must be on the driver's node "
-                    f"(actor on {actor_node}, driver on {my_node}); "
-                    "shard cross-node pipelines by stage"
-                )
 
         # Launch exec loops.
         started = [
@@ -289,16 +367,10 @@ class CompiledDAG:
         ]
         ray_tpu.get(started)
         self._actors = list(by_actor)
-        self._output_channels = [
-            (node_channel[id(n)], out_reader_idx[id(n)]) for n in outputs
-        ]
         self._output_readers = [
-            Channel(ch._oid, ch._view, ridx, ch._n_readers)
-            for ch, ridx in self._output_channels
+            attach_channel(node_desc[id(n)], out_reader_idx[id(n)])
+            for n in outputs
         ]
-        self._all_channels = list(node_channel.values()) + (
-            [self._input_channel] if self._input_channel else []
-        )
         self._multi_output = isinstance(output_node, MultiOutputNode)
 
     # ------------------------------------------------------------- execute
@@ -349,9 +421,29 @@ class CompiledDAG:
         if self._torn_down:
             return
         self._torn_down = True
-        for ch in self._all_channels:
+        import ray_tpu
+
+        for ch in self._local_channels:
             try:
                 ch.destroy()
+            except Exception:
+                pass
+        for rd in self._output_readers:
+            try:
+                rd.close()
+            except Exception:
+                pass
+        closes = []
+        for handle, token in self._remote_tokens:
+            try:
+                closes.append(
+                    handle.__ray_call__.remote(_remote_close_channel, token)
+                )
+            except Exception:
+                pass
+        for ref in closes:
+            try:
+                ray_tpu.get(ref, timeout=10)
             except Exception:
                 pass
 

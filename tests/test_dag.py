@@ -173,3 +173,84 @@ def test_compiled_dag_faster_than_uncompiled(dag_cluster):
     print(f"\ncompiled {n / t_compiled:,.0f} steps/s vs "
           f"uncompiled {n / t_uncompiled:,.0f} steps/s ({speedup:.1f}x)")
     assert speedup > 2.0, f"compiled DAG only {speedup:.2f}x faster"
+
+
+def test_compiled_dag_cross_node_pipeline():
+    """Multi-host pipeline parallelism: stages on different nodes connected
+    by socket channels (the DCN hop), same-node edges on shared memory
+    (reference: compiled_dag_node.py:391 + the NCCL channel's role,
+    torch_tensor_nccl_channel.py:191)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"resources": {"CPU": 3, "stage1": 1}},
+    )
+    cluster.add_node(resources={"CPU": 2, "stage2": 2})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        a = Adder.options(resources={"stage1": 1}).remote(1)  # head node
+        b = Doubler.options(resources={"stage2": 1}).remote()  # second node
+        c = Adder.options(resources={"stage2": 1}).remote(100)  # second node
+
+        # confirm the placement is actually cross-node
+        def node_of(h):
+            return ray_tpu.get(h.__ray_call__.remote(
+                lambda self: __import__("ray_tpu")
+                .get_runtime_context().get_node_id()
+            ))
+
+        assert node_of(a) != node_of(b)
+        assert node_of(b) == node_of(c)
+
+        with InputNode() as inp:
+            x = a.apply.bind(inp)     # driver -> head actor (shm)
+            y = b.apply.bind(x)       # head -> node2 (socket)
+            z = c.apply.bind(y)       # node2 -> node2 (shm on node2)
+        dag = z.experimental_compile()
+        try:
+            for i in range(30):
+                assert dag.execute(i).get() == (i + 1) * 2 + 100
+            # numpy payload across the socket edge
+            with InputNode() as inp2:
+                w = b.apply.bind(inp2)
+            dag2 = w.experimental_compile()
+            try:
+                arr = np.arange(1000, dtype=np.float32)
+                out = dag2.execute(arr).get()
+                assert np.allclose(out, arr * 2)
+            finally:
+                dag2.teardown()
+        finally:
+            dag.teardown()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_compiled_dag_cross_node_error_propagation():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"resources": {"CPU": 2}}
+    )
+    cluster.add_node(resources={"CPU": 2, "far": 1})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        a = Adder.options(resources={"far": 1}).remote(1)
+        with InputNode() as inp:
+            out = a.boom.bind(inp)
+        dag = out.experimental_compile()
+        try:
+            with pytest.raises(ValueError, match="boom"):
+                dag.execute(1).get()
+            # channel stays usable for the next tick after an error
+            with pytest.raises(ValueError, match="boom"):
+                dag.execute(2).get()
+        finally:
+            dag.teardown()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -1,0 +1,99 @@
+"""Pipeline-parallel train step (pp mesh axis): GPipe schedule correctness
+vs the sequential stack, and an end-to-end sharded training step.
+
+Reference analogue: the compiled-DAG pipeline tests (python/ray/dag/tests/);
+here the within-slice pipeline is a mesh axis + ppermute schedule, so the
+correctness bar is exact equivalence with the unpipelined forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.gpt2 import Block, GPT2Config
+from ray_tpu.parallel.mesh import make_mesh
+from ray_tpu.parallel.pipeline import PipelineTrainStep, pipeline_apply
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs >=4 virtual devices"
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, block_size=32, n_layer=4, n_head=2, n_embd=32,
+        dtype=jnp.float32, use_flash_attention=False,
+    )
+    base.update(kw)
+    return GPT2Config(**base)
+
+
+def test_pipeline_forward_matches_sequential():
+    cfg = _cfg()
+    mesh = make_mesh({"dp": 2, "pp": 2}, devices=jax.devices()[:4])
+    ts = PipelineTrainStep(cfg, mesh, num_microbatches=4)
+    state = ts.init(jax.random.PRNGKey(0))
+    idx = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (8, cfg.block_size)),
+        dtype=jnp.int32,
+    )
+
+    logits_pp = ts.forward(state["params"], ts.shard_batch({"idx": idx})["idx"])
+
+    # sequential reference: same params, plain python loop over the stack
+    params = jax.device_get(state["params"])
+    block = Block(cfg)
+    h = (
+        params["wte"][np.asarray(idx)]
+        + params["wpe"][np.arange(cfg.block_size)][None]
+    ).astype(np.float32)
+    h = jnp.asarray(h)
+    for i in range(cfg.n_layer):
+        layer = jax.tree.map(lambda x: x[i], params["blocks"])
+        h = block.apply({"params": layer}, h)
+    mean = h.mean(-1, keepdims=True)
+    var = ((h - mean) ** 2).mean(-1, keepdims=True)
+    h = (h - mean) * jax.lax.rsqrt(var + 1e-5)
+    h = h * params["ln_f"]["scale"] + params["ln_f"]["bias"]
+    logits_ref = h.astype(jnp.float32) @ params["wte"].T
+
+    err = jnp.abs(logits_pp - logits_ref).max()
+    assert err < 2e-4, f"pipeline diverges from sequential: {err}"
+
+
+def test_pipeline_train_step_learns():
+    cfg = _cfg()
+    mesh = make_mesh({"dp": 2, "pp": 2}, devices=jax.devices()[:4])
+    ts = PipelineTrainStep(cfg, mesh, num_microbatches=2, learning_rate=1e-2)
+    state = ts.init(jax.random.PRNGKey(1))
+    rng = np.random.RandomState(1)
+    idx = rng.randint(0, cfg.vocab_size, (4, cfg.block_size)).astype(np.int32)
+    batch = ts.shard_batch(
+        {"idx": jnp.asarray(idx), "targets": jnp.asarray(np.roll(idx, -1, 1))}
+    )
+    losses = []
+    for _ in range(5):
+        state, metrics = ts.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+    # block grads/params stay sharded over pp
+    stacked = state["params"]["blocks"]
+    leaf = jax.tree.leaves(stacked)[0]
+    assert "pp" in str(leaf.sharding.spec)
+
+
+def test_pipeline_apply_pp4():
+    """pp=4 with a trivially-checkable block (x + w)."""
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    L, B, T, D = 8, 4, 2, 4
+    w = jnp.arange(L, dtype=jnp.float32).reshape(L, 1, 1, 1)
+
+    def add_block(p, x):
+        return x + p
+
+    h = jnp.ones((B, T, D), jnp.float32)
+    out = pipeline_apply(mesh, lambda p, x: x + p, w, h, num_micro=4)
+    expected = 1.0 + sum(range(L))
+    assert jnp.allclose(out, expected), (out.ravel()[0], expected)
