@@ -1,0 +1,154 @@
+"""Scalability-envelope benchmark (scaled-down port of the reference's
+release/benchmarks/README.md:9-31 suite: many tasks, many actors, many
+placement groups, object broadcast, many args).
+
+Run:  python envelope.py            # full sizes, writes ENVELOPE.json
+      python envelope.py --quick    # reduced sizes (CI smoke)
+
+All scenarios run against a real in-process multi-node cluster (one
+machine, multiple raylets — the reference's cluster_utils pattern). The
+reference numbers come from 64-node clusters; this box has ONE core, so
+the interesting property is that every scenario COMPLETES and scales
+linearly in n, not the absolute rates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run(quick: bool = False) -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.broadcast import broadcast_object
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    n_tasks = 5_000 if quick else 50_000
+    n_actors = 200 if quick else 1_000
+    n_pgs = 50 if quick else 200
+    bcast_mb = 64 if quick else 512
+    n_args = 1_000 if quick else 5_000
+
+    results = {}
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"resources": {"CPU": 4}}
+    )
+    for i in range(3):
+        cluster.add_node(resources={"CPU": 2})
+    cluster.wait_for_nodes()
+    ray_tpu.init(address=cluster.address)
+    try:
+        # ---- queued-task drain (reference: 1M+ queued tasks) ----
+        @ray_tpu.remote
+        def tiny():
+            return 1
+
+        ray_tpu.get(tiny.remote())
+        t0 = time.perf_counter()
+        refs = [tiny.remote() for _ in range(n_tasks)]
+        t_submit = time.perf_counter() - t0
+        ray_tpu.get(refs)
+        t_total = time.perf_counter() - t0
+        results["queued_tasks"] = {
+            "n": n_tasks,
+            "submit_per_s": round(n_tasks / t_submit, 1),
+            "drain_per_s": round(n_tasks / t_total, 1),
+        }
+        print(f"queued_tasks: {results['queued_tasks']}")
+        del refs
+
+        # ---- many actors (reference: 40k+ across a cluster) ----
+        @ray_tpu.remote(num_cpus=0.001)
+        class A:
+            def ping(self):
+                return 1
+
+        t0 = time.perf_counter()
+        actors = [A.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        dt = time.perf_counter() - t0
+        results["many_actors"] = {
+            "n": n_actors, "create_and_ping_per_s": round(n_actors / dt, 1),
+        }
+        print(f"many_actors: {results['many_actors']}")
+        for a in actors:
+            ray_tpu.kill(a)
+        del actors
+
+        # ---- many placement groups (reference: 1k+ simultaneous) ----
+        t0 = time.perf_counter()
+        pgs = [
+            placement_group([{"CPU": 0.001}]) for _ in range(n_pgs)
+        ]
+        for pg in pgs:
+            pg.ready()
+        dt = time.perf_counter() - t0
+        results["many_pgs"] = {
+            "n": n_pgs, "create_per_s": round(n_pgs / dt, 1),
+        }
+        t0 = time.perf_counter()
+        for pg in pgs:
+            remove_placement_group(pg)
+        results["many_pgs"]["remove_per_s"] = round(
+            n_pgs / (time.perf_counter() - t0), 1
+        )
+        print(f"many_pgs: {results['many_pgs']}")
+
+        # ---- object broadcast (reference: 1 GiB to 50+ nodes) ----
+        data = np.zeros(bcast_mb * 1024 * 1024 // 8, dtype=np.float64)
+        ref = ray_tpu.put(data)
+        t0 = time.perf_counter()
+        stats = broadcast_object(ref)
+        dt = time.perf_counter() - t0
+        srcs = {s for s, _ in stats["transfers"]}
+        results["broadcast"] = {
+            "mb": bcast_mb,
+            "nodes": len(stats["nodes"]),
+            "seconds": round(dt, 2),
+            "mb_per_s": round(bcast_mb * len(stats["transfers"]) / dt, 1),
+            "rounds": stats["rounds"],
+            "distinct_sources": len(srcs),
+        }
+        print(f"broadcast: {results['broadcast']}")
+        assert len(srcs) >= 2, "broadcast must fan out from >=2 sources"
+        del ref, data
+
+        # ---- many args to one task (reference: 10k+ args) ----
+        @ray_tpu.remote
+        def consume(*args):
+            return len(args)
+
+        t0 = time.perf_counter()
+        assert ray_tpu.get(consume.remote(*range(n_args))) == n_args
+        results["many_args"] = {
+            "n": n_args,
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+        print(f"many_args: {results['many_args']}")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    results = run(quick=args.quick)
+    if not args.quick:
+        with open("ENVELOPE.json", "w") as f:
+            json.dump(results, f, indent=2)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

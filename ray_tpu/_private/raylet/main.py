@@ -99,6 +99,7 @@ class NodeManager:
         self._autoscaler_active = False
         # object pulls in flight: object_id bytes -> asyncio.Event
         self._pulls: Dict[bytes, asyncio.Event] = {}
+        self._recv: Dict[bytes, dict] = {}  # inbound pushes mid-transfer
         # pinned primary copies: object_id bytes -> memoryview
         self._pinned: Dict[bytes, memoryview] = {}
         # spilled primaries: object_id bytes -> (path, size). A spilled object
@@ -932,6 +933,13 @@ class NodeManager:
         while True:
             await asyncio.sleep(period)
             try:
+                # reclaim unsealed inbound-push buffers whose pusher died
+                for oid, rec in list(self._recv.items()):
+                    if time.time() - rec["t"] > 120:
+                        logger.warning(
+                            "aborting stale inbound push %s", oid.hex()[:12]
+                        )
+                        self._abort_recv(oid)
                 if not self._pinned:
                     continue
                 s = self.plasma.stats()
@@ -1153,6 +1161,150 @@ class NodeManager:
         self.plasma.release(oid)
         return {"found": True, "data": data}
 
+    # ------------------------------------------------- push path (outbound)
+
+    async def handle_PushObject(self, req):
+        """Push a locally-held object to a target raylet (reference:
+        ObjectManager::Push, object_manager/object_manager.cc:339 +
+        push_manager.h). The owner (or the broadcast helper) hints the
+        destination; chunks stream holder->target so the target never has
+        to discover a source."""
+        oid = req["object_id"]
+        target = req["target"]  # node_id bytes
+        owner_addr = req.get("owner_addr")
+        info = self.cluster_view.get(target)
+        if info is None:
+            return {"ok": False, "error": "unknown target node"}
+        view = self.plasma.get(oid)
+        size = None
+        if view is None:
+            spilled = self._spilled.get(oid)
+            if spilled is None:
+                return {"ok": False, "error": "object not local"}
+            size = spilled[1]
+        else:
+            size = view.nbytes
+        try:
+            peer = await self.pool.get(info["ip"], info["raylet_port"])
+            begin = await peer.call(
+                "ReceiveBegin",
+                {"object_id": oid, "size": size,
+                 "owner_addr": list(owner_addr) if owner_addr else None},
+                timeout=30,
+            )
+            if begin.get("already"):
+                return {"ok": True, "already": True}
+            if not begin.get("ok"):
+                return {"ok": False, "error": begin.get("error", "begin failed")}
+            chunk = RTPU_CONFIG.object_manager_chunk_size
+            # chunks are offset-addressed, so pipeline them (windowed
+            # gather) instead of paying one RTT per 4 MiB — same treatment
+            # the pull path's striped fetch got
+            sem = asyncio.Semaphore(8)
+            loop = asyncio.get_running_loop()
+
+            async def send_one(offset):
+                n = min(chunk, size - offset)
+                if view is not None:
+                    data = bytes(view[offset:offset + n])
+                else:
+                    spilled = self._spilled.get(oid)
+
+                    def _read(path=spilled[0], off=offset, ln=n):
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            return f.read(ln)
+
+                    data = await loop.run_in_executor(None, _read)
+                async with sem:
+                    r = await peer.call(
+                        "ReceiveChunk",
+                        {"object_id": oid, "offset": offset, "data": data},
+                        timeout=60,
+                    )
+                return bool(r.get("ok"))
+
+            oks = await asyncio.gather(
+                *(send_one(off) for off in range(0, size, chunk))
+            )
+            if not all(oks):
+                return {"ok": False, "error": "target aborted"}
+            r = await peer.call("ReceiveEnd", {"object_id": oid}, timeout=30)
+            return {"ok": bool(r.get("ok"))}
+        except Exception as e:
+            return {"ok": False, "error": str(e)}
+        finally:
+            if view is not None:
+                view.release()
+                self.plasma.release(oid)
+
+    # ------------------------------------------------- push path (inbound)
+
+    def _abort_recv(self, oid: bytes):
+        rec = self._recv.pop(oid, None)
+        if rec is not None:
+            try:
+                rec["view"].release()
+            except Exception:
+                pass
+            try:
+                self.plasma.abort(oid)
+            except Exception:
+                pass
+
+    async def handle_ReceiveBegin(self, req):
+        oid = req["object_id"]
+        if self.plasma.contains(oid):
+            return {"ok": True, "already": True}
+        rec = self._recv.get(oid)
+        if rec is not None:
+            # A dead pusher must not wedge this object forever: reclaim the
+            # unsealed buffer once the transfer has gone idle, otherwise
+            # report busy (NOT success — the object is not here yet).
+            if time.time() - rec["t"] > 60:
+                self._abort_recv(oid)
+            else:
+                return {"ok": False, "error": "push already in progress"}
+        try:
+            dest = await self._plasma_create_with_room(oid, req["size"])
+        except FileExistsError:
+            return {"ok": True, "already": True}
+        except PlasmaOOM:
+            return {"ok": False, "error": "no plasma room"}
+        self._recv[oid] = {
+            "view": dest, "size": req["size"],
+            "owner_addr": req.get("owner_addr"), "t": time.time(),
+        }
+        return {"ok": True}
+
+    async def handle_ReceiveChunk(self, req):
+        rec = self._recv.get(req["object_id"])
+        if rec is None:
+            return {"ok": False}
+        off, data = req["offset"], req["data"]
+        rec["view"][off:off + len(data)] = data
+        rec["t"] = time.time()
+        return {"ok": True}
+
+    async def handle_ReceiveEnd(self, req):
+        oid = req["object_id"]
+        rec = self._recv.pop(oid, None)
+        if rec is None:
+            return {"ok": False}
+        rec["view"].release()
+        self.plasma.seal(oid)
+        owner_addr = rec.get("owner_addr")
+        if owner_addr:
+            try:
+                owner = await self.pool.get(owner_addr[0], owner_addr[1])
+                await owner.notify(
+                    "AddObjectLocation",
+                    {"object_id": oid, "node_id": self.node_id.binary()},
+                )
+            except Exception:
+                pass
+        return {"ok": True}
+
     async def handle_PullObject(self, req):
         """Make the object local; replies once it is sealed in local plasma.
 
@@ -1200,61 +1352,90 @@ class NodeManager:
             except Exception as e:
                 logger.warning("pull %s: owner unreachable: %s", oid.hex()[:12], e)
                 return False
+        # Broadcast-friendly source selection: shuffle so concurrent pullers
+        # of a hot object spread over ALL registered holders instead of all
+        # hammering the primary (new copies register with the owner as they
+        # complete, so the source set grows as a broadcast fans out —
+        # reference: push_manager.h + ownership_based_object_directory.h).
+        import random as _random
+
+        locations = [l for l in locations if l != self.node_id.binary()]
+        _random.shuffle(locations)
+        peers = []
+        size = None
         for loc in locations:
-            if loc == self.node_id.binary():
-                continue
             info = self.cluster_view.get(loc)
             if info is None:
                 continue
             try:
                 peer = await self.pool.get(info["ip"], info["raylet_port"])
-                meta = await peer.call("FetchObjectInfo", {"object_id": oid}, timeout=30)
-                if not meta.get("found"):
-                    continue
-                size = meta["size"]
-                try:
-                    dest = await self._plasma_create_with_room(oid, size)
-                except FileExistsError:
-                    return True
-                except PlasmaOOM:
-                    logger.warning(
-                        "pull %s: no room even after spilling", oid.hex()[:12]
-                    )
-                    return False
-                chunk = RTPU_CONFIG.object_manager_chunk_size
-                offset = 0
-                try:
-                    while offset < size:
-                        n = min(chunk, size - offset)
+                meta = await peer.call(
+                    "FetchObjectInfo", {"object_id": oid}, timeout=30
+                )
+                if meta.get("found"):
+                    size = meta["size"]
+                    peers.append(peer)
+                    if len(peers) >= 4:
+                        break
+            except Exception as e:
+                logger.warning(
+                    "pull %s: holder %s unusable: %s",
+                    oid.hex()[:12], loc.hex()[:12], e,
+                )
+        if not peers:
+            return False
+        try:
+            dest = await self._plasma_create_with_room(oid, size)
+        except FileExistsError:
+            return True
+        except PlasmaOOM:
+            logger.warning("pull %s: no room even after spilling", oid.hex()[:12])
+            return False
+        # Chunks fetch CONCURRENTLY, striped across every viable holder
+        # (reference: object_buffer_pool chunked transfer) — a large object
+        # rides multiple source NICs instead of one.
+        chunk = RTPU_CONFIG.object_manager_chunk_size
+        offsets = list(range(0, size, chunk))
+        sem = asyncio.Semaphore(8)
+
+        async def fetch_one(i, off):
+            n = min(chunk, size - off)
+            order = peers[i % len(peers):] + peers[:i % len(peers)]
+            async with sem:
+                for peer in order:
+                    try:
                         r = await peer.call(
                             "FetchChunk",
-                            {"object_id": oid, "offset": offset, "size": n},
+                            {"object_id": oid, "offset": off, "size": n},
                             timeout=60,
                         )
-                        if not r.get("found"):
-                            raise IOError("holder evicted object mid-transfer")
-                        dest[offset : offset + n] = r["data"]
-                        offset += n
-                except Exception:
-                    dest.release()
-                    self.plasma.abort(oid)
-                    continue
-                dest.release()
-                self.plasma.seal(oid)
-                # register the new copy with the owner
-                if owner_addr:
-                    try:
-                        owner = await self.pool.get(owner_addr[0], owner_addr[1])
-                        await owner.notify(
-                            "AddObjectLocation",
-                            {"object_id": oid, "node_id": self.node_id.binary()},
-                        )
                     except Exception:
-                        pass
-                return True
-            except Exception as e:
-                logger.warning("pull %s from %s failed: %s", oid.hex()[:12], loc.hex()[:12], e)
-        return False
+                        continue
+                    if r.get("found"):
+                        dest[off:off + n] = r["data"]
+                        return True
+                return False
+
+        results = await asyncio.gather(
+            *(fetch_one(i, off) for i, off in enumerate(offsets))
+        )
+        if not all(results):
+            dest.release()
+            self.plasma.abort(oid)
+            return False
+        dest.release()
+        self.plasma.seal(oid)
+        # register the new copy with the owner
+        if owner_addr:
+            try:
+                owner = await self.pool.get(owner_addr[0], owner_addr[1])
+                await owner.notify(
+                    "AddObjectLocation",
+                    {"object_id": oid, "node_id": self.node_id.binary()},
+                )
+            except Exception:
+                pass
+        return True
 
     async def handle_GetLocalObjectInfo(self, req):
         """State-API source: this node's plasma + spilled objects."""

@@ -106,6 +106,12 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "SpillObjects": {"bytes": int},
     "PinObject": {"object_id": bytes, "owner_addr?": _addr},
     "FreeObjects": {"ids": list},
+    "PushObject": {"object_id": bytes, "target": bytes,
+                   "owner_addr?": (_addr, type(None))},
+    "ReceiveBegin": {"object_id": bytes, "size": int,
+                     "owner_addr?": (_addr, type(None))},
+    "ReceiveChunk": {"object_id": bytes, "offset": int, "data": bytes},
+    "ReceiveEnd": {"object_id": bytes},
     "FetchObjectInfo": {"object_id": bytes},
     "FetchChunk": {"object_id": bytes, "offset": int, "size": int},
     "PullObject": {"object_id": bytes, "owner_addr?": _addr},

@@ -433,6 +433,14 @@ class CompiledDAG:
                 rd.close()
             except Exception:
                 pass
+            # shm readers pin the 4 MiB channel segment via plasma.get at
+            # attach; drop the pin or every compile/teardown cycle leaks it
+            release = getattr(rd, "release", None)
+            if release is not None:
+                try:
+                    release()
+                except Exception:
+                    pass
         closes = []
         for handle, token in self._remote_tokens:
             try:

@@ -335,29 +335,15 @@ class SocketChannel:
         self._addr = None
         self._token = os.urandom(8)
         self._acked: Dict[int, int] = {}  # per reader: last ack consumed
-        self._rxbuf: Dict[int, bytes] = {}  # per reader: partial ack bytes
+        self._rxbuf: Dict[int, bytearray] = {}  # per reader: partial acks
 
     def _recv_buffered(self, ridx, conn, n: int, deadline) -> bytes:
-        """recv exactly n bytes, resumable across timeouts: partial bytes
-        stay buffered so a retry continues mid-frame instead of desyncing."""
-        buf = self._rxbuf.get(ridx, b"")
-        while len(buf) < n:
-            conn.settimeout(
-                None if deadline is None
-                else max(0.01, deadline - time.monotonic())
-            )
-            try:
-                chunk = conn.recv(n - len(buf))
-            except TimeoutError:
-                self._rxbuf[ridx] = buf
-                raise TimeoutError("channel write timed out awaiting ack")
-            except OSError as e:
-                raise ChannelClosed(f"reader {ridx} gone: {e}")
-            if not chunk:
-                raise ChannelClosed(f"reader {ridx} closed the connection")
-            buf += chunk
-        self._rxbuf[ridx] = buf[n:]
-        return buf[:n]
+        buf = self._rxbuf.setdefault(ridx, bytearray())
+        return _buffered_recv_exact(
+            conn, buf, n, deadline,
+            timeout_msg="channel write timed out awaiting ack",
+            closed_msg=f"reader {ridx} gone",
+        )
 
     # --------------------------------------------------------------- writer
 
@@ -435,8 +421,20 @@ class SocketChannel:
         header = struct.pack("<QIQ", self._seq,
                              _FLAG_ERROR if is_error else 0, len(blob))
         for ridx, conn in list(self._conns.items()):
+            # honor the caller's deadline during the send too: a reader
+            # stalled with a full kernel buffer must not block forever. A
+            # timeout mid-frame is unrecoverable for this stream
+            # (sendall may have written part of the frame) -> ChannelClosed.
+            conn.settimeout(
+                None if deadline is None
+                else max(0.01, deadline - time.monotonic())
+            )
             try:
                 conn.sendall(header + blob)
+            except TimeoutError:
+                raise ChannelClosed(
+                    f"reader {ridx} stalled mid-frame (send timeout)"
+                )
             except OSError as e:
                 raise ChannelClosed(f"reader {ridx} gone: {e}")
 
@@ -480,29 +478,15 @@ class _SocketReader:
             descriptor["token"] + struct.pack("<I", reader_index)
         )
         self._sock.settimeout(None)
-        self._rxbuf = b""
+        self._rxbuf = bytearray()
         self._hdr = None  # parsed header of a frame whose body is pending
 
     def _recv_exact(self, n: int, deadline) -> bytes:
-        """Resumable recv: partial bytes survive a timeout so the next
-        read() continues mid-frame instead of desyncing the stream."""
-        while len(self._rxbuf) < n:
-            self._sock.settimeout(
-                None if deadline is None
-                else max(0.01, deadline - time.monotonic())
-            )
-            try:
-                chunk = self._sock.recv(n - len(self._rxbuf))
-            except TimeoutError:
-                raise TimeoutError("channel read timed out")
-            except OSError as e:
-                raise ChannelClosed(f"writer closed the channel: {e}")
-            if not chunk:
-                raise ChannelClosed("writer closed the channel")
-            self._rxbuf += chunk
-        out = self._rxbuf[:n]
-        self._rxbuf = self._rxbuf[n:]
-        return out
+        return _buffered_recv_exact(
+            self._sock, self._rxbuf, n, deadline,
+            timeout_msg="channel read timed out",
+            closed_msg="writer closed the channel",
+        )
 
     def read(self, timeout: Optional[float] = None) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -528,6 +512,32 @@ class _SocketReader:
 
     def destroy(self):
         self.close()
+
+
+def _buffered_recv_exact(sock, buf: bytearray, n: int, deadline,
+                         timeout_msg: str, closed_msg: str) -> bytes:
+    """Shared resumable recv over a caller-owned bytearray: consumes and
+    returns n bytes once available. Partial bytes accumulate IN PLACE, so
+    they survive a timeout and a retry continues mid-frame instead of
+    desyncing the stream. TimeoutError means retryable; ChannelClosed
+    means the peer is gone."""
+    while len(buf) < n:
+        sock.settimeout(
+            None if deadline is None
+            else max(0.01, deadline - time.monotonic())
+        )
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            raise TimeoutError(timeout_msg) from None
+        except OSError as e:
+            raise ChannelClosed(f"{closed_msg}: {e}")
+        if not chunk:
+            raise ChannelClosed(closed_msg)
+        buf += chunk
+    out = bytes(buf[:n])
+    del buf[:n]
+    return out
 
 
 def _recv_exact(sock, n: int) -> bytes:

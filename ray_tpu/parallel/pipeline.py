@@ -138,11 +138,20 @@ class PipelineTrainStep:
         self.mesh = mesh
         self.pp = pp
         self.num_micro = num_microbatches or 2 * pp
+        def decay_mask(params):
+            # Stacking adds a leading layer dim, so inside `blocks` a bias
+            # is 2-D and a kernel 3-D; the decay rule must match the
+            # unstacked TrainStep (decay kernels, not biases/norms).
+            def f(path, p):
+                keys = [getattr(k, "key", "") for k in path]
+                return p.ndim > (2 if "blocks" in keys else 1)
+
+            return jax.tree_util.tree_map_with_path(f, params)
+
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip),
             optax.adamw(
-                learning_rate, weight_decay=weight_decay,
-                mask=lambda params: jax.tree.map(lambda p: p.ndim > 1, params),
+                learning_rate, weight_decay=weight_decay, mask=decay_mask,
             ),
         )
         cfg = model_cfg

@@ -1,0 +1,48 @@
+"""Scalability-envelope regression floors (reference:
+release/benchmarks/README.md). Runs envelope.py's quick mode against a real
+4-raylet cluster and asserts coarse floors — the goal is catching
+regressions in completion and fan-out behavior, not absolute rates (the
+box's rates live in ENVELOPE.json)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+
+def _load_envelope():
+    path = os.path.join(os.path.dirname(__file__), "..", "envelope.py")
+    spec = importlib.util.spec_from_file_location("envelope", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.timeout(600)
+def test_envelope_quick_floors():
+    env = _load_envelope()
+    r = env.run(quick=True)
+
+    # queued-task drain completes and sustains a sane rate
+    assert r["queued_tasks"]["n"] == 5_000
+    assert r["queued_tasks"]["drain_per_s"] > 300
+
+    # hundreds of actors all come up and answer
+    assert r["many_actors"]["n"] == 200
+    assert r["many_actors"]["create_and_ping_per_s"] > 2
+
+    # PG churn
+    assert r["many_pgs"]["create_per_s"] > 30
+    assert r["many_pgs"]["remove_per_s"] > 30
+
+    # broadcast reaches every node via tree fan-out (>=2 sources, <=N-1
+    # transfers, log rounds) — the push path, not N serial pulls
+    b = r["broadcast"]
+    assert b["nodes"] == 4
+    assert b["distinct_sources"] >= 2
+    assert b["rounds"] <= 2
+
+    # thousands of args to one task in bounded time
+    assert r["many_args"]["n"] == 1_000
+    assert r["many_args"]["seconds"] < 10
