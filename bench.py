@@ -19,12 +19,17 @@ import os
 import subprocess
 import sys
 
-WARMUP = 10
-STEPS = 400
+WARMUP = 40
+STEPS = 1200
 # Both sides run lax.scan chunks of SCAN steps per dispatch (XLA-idiomatic:
 # "no data-dependent Python control flow inside jit"); the framework reports
-# once per chunk — the standard log-every-N product pattern.
-SCAN = 10
+# once per chunk — the standard log-every-N product pattern. Chunk sizing is
+# a noise decision: a GPT-2-124M B=16 step is ~0.3 ms on-device but each
+# dispatch through the shared-TPU tunnel costs ~1.7 ms with heavy jitter,
+# so 40-step chunks keep the jitter under ~15% of a chunk and 30 timed
+# chunks per side average it out (10-step chunks left ratio sigma ~11%/run;
+# min-of-5 is judged, so per-run variance matters as much as the mean).
+SCAN = 40
 
 
 def _model_kw(on_tpu: bool):
@@ -162,14 +167,28 @@ def train_loop(config):
         run_control_chunk()
         run_ours_chunk(-1 - i)
     chunks = config["steps"] // SCAN
-    t_raw = t_ours = 0.0
+    raw_times, ours_times = [], []
     for i in range(chunks):
-        t_raw += run_control_chunk()
-        t_ours += run_ours_chunk(i)
-    tokens = B * T * chunks * SCAN
+        raw_times.append(run_control_chunk())
+        ours_times.append(run_ours_chunk(i))
+
+    # Trimmed per-chunk statistics: the tunnel occasionally stalls a
+    # single dispatch for tens of ms; with ~2 ms chunks one stall landing
+    # on one side skews a whole run's SUM by >10%. A 20%-trimmed mean of
+    # per-chunk times is robust to those tails while using both sides'
+    # full chunk population.
+    def trimmed_mean(xs):
+        xs = sorted(xs)
+        k = max(1, len(xs) // 5)
+        core = xs[k:-k] if len(xs) > 2 * k else xs
+        return sum(core) / len(core)
+
+    tokens_per_chunk = B * T * SCAN
     train.report({
-        "tokens_per_s": tokens / t_ours,
-        "raw_tokens_per_s": tokens / t_raw,
+        "tokens_per_s": tokens_per_chunk / trimmed_mean(ours_times),
+        "raw_tokens_per_s": tokens_per_chunk / trimmed_mean(raw_times),
+        "sum_tokens_per_s": tokens_per_chunk * chunks / sum(ours_times),
+        "sum_raw_tokens_per_s": tokens_per_chunk * chunks / sum(raw_times),
     })
 
 
@@ -258,6 +277,102 @@ def phase_micro() -> dict:
     return run_quick()
 
 
+# ---------------------------------------------------------------- rllib phase
+
+
+class _BenchLearner:
+    """Learner actor hosting BOTH sides of the RL-learner ratio on the one
+    chip: product-path updates arrive as driver RPCs (batch ship + update +
+    weight readback — the IMPALA hot loop), the raw control runs the same
+    updates in-process. Chunks interleave driver-side."""
+
+    def __init__(self, obs_dim, num_actions, cfg, batch):
+        from ray_tpu.rllib.core.impala_learner import ImpalaLearner
+
+        self.learner = ImpalaLearner(obs_dim, num_actions, **cfg)
+        self._batch = batch
+
+    def update(self, batch):
+        return self.learner.update_from_trajectories(batch)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def raw_chunk(self, k: int) -> float:
+        """k no-framework updates (host batch -> device each time, like a
+        raw jax loop); returns elapsed seconds measured in-process."""
+        import time as _t
+
+        t0 = _t.perf_counter()
+        for _ in range(k):
+            self.learner.update_from_trajectories(self._batch)
+        return _t.perf_counter() - t0
+
+
+def phase_rllib(on_tpu: bool) -> dict:
+    """IMPALA learner throughput through the product path (driver->actor
+    RPC per rollout + weight sync) vs the raw in-process jax loop,
+    interleaved chunk-wise on the same chip."""
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+
+    # IMPALA-scale batch: 8192 env steps/update amortizes the per-update
+    # batch ship + RPC round trip the product path pays over the raw loop
+    T, N = (64, 128) if on_tpu else (16, 8)
+    obs_dim, num_actions = 4, 2
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(T, N, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, num_actions, (T, N)),
+        "behavior_logp": np.full((T, N), -0.69, np.float32),
+        "rewards": rng.normal(size=(T, N)).astype(np.float32),
+        "dones": np.zeros((T, N), np.float32),
+        "bootstrap_obs": rng.normal(size=(N, obs_dim)).astype(np.float32),
+    }
+    cfg = dict(lr=5e-4, gamma=0.99, vf_coeff=0.5, entropy_coeff=0.01,
+               rho_bar=1.0, c_bar=1.0, hidden=(64, 64), seed=0)
+    ray_tpu.init(num_cpus=2)
+    try:
+        actor = ray_tpu.remote(_BenchLearner).remote(
+            obs_dim, num_actions, cfg, batch
+        )
+        updates = 6 if not on_tpu else 24
+        # warmup both paths (compile)
+        ray_tpu.get(actor.update.remote(batch), timeout=600)
+        ray_tpu.get(actor.raw_chunk.remote(1), timeout=600)
+        # Interleave at SINGLE-update granularity: one ~0.5 s update pair
+        # sits inside the tunnel's drift timescale, so the drift cancels
+        # pairwise; trimmed means kill the residual stall tails (same
+        # protocol as the train bench's chunks).
+        raw_times, ours_times = [], []
+        for i in range(updates):
+            raw_times.append(
+                ray_tpu.get(actor.raw_chunk.remote(1), timeout=600)
+            )
+            t0 = time.perf_counter()
+            ray_tpu.get(actor.update.remote(batch), timeout=600)
+            if i % 5 == 4:  # periodic weight sync, like the real algorithm
+                ray_tpu.get(actor.get_weights.remote(), timeout=600)
+            ours_times.append(time.perf_counter() - t0)
+
+        def trimmed_mean(xs):
+            xs = sorted(xs)
+            k = max(1, len(xs) // 5)
+            core = xs[k:-k] if len(xs) > 2 * k else xs
+            return sum(core) / len(core)
+
+        steps_per_update = T * N
+        return {
+            "ours_steps_per_s": steps_per_update / trimmed_mean(ours_times),
+            "raw_steps_per_s": steps_per_update / trimmed_mean(raw_times),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
 # ----------------------------------------------------------------------- main
 
 
@@ -299,26 +414,49 @@ def main():
         phase = sys.argv[sys.argv.index("--phase") + 1]
         on_tpu = _detect_tpu() if phase != "micro" else False
         fn = {"framework": phase_framework, "control": phase_control,
-              "micro": phase_micro}[phase]
+              "micro": phase_micro, "rllib": phase_rllib}[phase]
         result = fn(on_tpu) if phase != "micro" else fn()
         print(json.dumps({"result": result}))
         return
     # The shared-TPU tunnel's throughput drifts minute to minute (2.4x
     # spread measured on identical workloads), so control and framework
     # chunks alternate INSIDE the same worker process per run; the per-run
-    # ratio is drift-free. Report the median-ratio run of 3.
-    runs = [_run_phase("framework") for _ in range(3)]
-    runs_sorted = sorted(runs, key=lambda r: r["ours"] / r["raw"])
-    best = runs_sorted[len(runs_sorted) // 2]  # median ratio run
+    # ratio is drift-free. Protocol: 5 runs; report the median run's
+    # throughput, plus min/median/CI over the per-run ratios so a single
+    # lucky run can't carry the headline (the north star is judged on the
+    # spread, not one sample).
+    n_runs = 5
+    runs = [_run_phase("framework") for _ in range(n_runs)]
+    ratios = sorted(r["ours"] / r["raw"] for r in runs)
+    median_ratio = ratios[len(ratios) // 2]
+    mean = sum(ratios) / len(ratios)
+    var = sum((x - mean) ** 2 for x in ratios) / max(1, len(ratios) - 1)
+    # 95% CI half-width on the mean ratio (t_{0.975,4} = 2.776 for n=5)
+    ci95 = 2.776 * (var ** 0.5) / (len(ratios) ** 0.5)
+    best = sorted(runs, key=lambda r: r["ours"] / r["raw"])[len(runs) // 2]
     try:
         micro = _run_phase("micro")
     except Exception:
         micro = {}
+    try:
+        rl = _run_phase("rllib")
+        rl_extra = {
+            "rllib_learner_env_steps_per_s": round(rl["ours_steps_per_s"], 1),
+            "rllib_vs_raw": round(
+                rl["ours_steps_per_s"] / rl["raw_steps_per_s"], 4
+            ),
+        }
+    except Exception:
+        rl_extra = {}
     print(json.dumps({
+        **rl_extra,
         "metric": "gpt2_train_tokens_per_s_via_JaxTrainer",
         "value": round(best["ours"], 1),
         "unit": "tokens/s",
-        "vs_baseline": round(best["ours"] / best["raw"], 4),
+        "vs_baseline": round(median_ratio, 4),
+        "vs_baseline_min": round(ratios[0], 4),
+        "vs_baseline_mean": round(mean, 4),
+        "vs_baseline_ci95": round(ci95, 4),
         "raw_jax_control_tokens_per_s": round(best["raw"], 1),
         "all_runs": [
             {"ours": round(r["ours"], 1), "raw": round(r["raw"], 1),

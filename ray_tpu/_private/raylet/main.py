@@ -1205,18 +1205,21 @@ class NodeManager:
 
             async def send_one(offset):
                 n = min(chunk, size - offset)
-                if view is not None:
-                    data = bytes(view[offset:offset + n])
-                else:
-                    spilled = self._spilled.get(oid)
-
-                    def _read(path=spilled[0], off=offset, ln=n):
-                        with open(path, "rb") as f:
-                            f.seek(off)
-                            return f.read(ln)
-
-                    data = await loop.run_in_executor(None, _read)
+                # materialize the chunk INSIDE the window: reading all
+                # chunks up front would copy the whole object onto the heap
+                # at once — the window bounds memory to 8 chunks
                 async with sem:
+                    if view is not None:
+                        data = bytes(view[offset:offset + n])
+                    else:
+                        spilled = self._spilled.get(oid)
+
+                        def _read(path=spilled[0], off=offset, ln=n):
+                            with open(path, "rb") as f:
+                                f.seek(off)
+                                return f.read(ln)
+
+                        data = await loop.run_in_executor(None, _read)
                     r = await peer.call(
                         "ReceiveChunk",
                         {"object_id": oid, "offset": offset, "data": data},
@@ -1256,6 +1259,10 @@ class NodeManager:
         oid = req["object_id"]
         if self.plasma.contains(oid):
             return {"ok": True, "already": True}
+        if oid in self._pulls:
+            # a pull is mid-transfer for the same object; "already" would be
+            # a lie (the copy isn't here yet) — pushers retry or move on
+            return {"ok": False, "error": "pull already in progress"}
         rec = self._recv.get(oid)
         if rec is not None:
             # A dead pusher must not wedge this object forever: reclaim the
@@ -1268,7 +1275,11 @@ class NodeManager:
         try:
             dest = await self._plasma_create_with_room(oid, req["size"])
         except FileExistsError:
-            return {"ok": True, "already": True}
+            # an unsealed buffer we don't own (e.g. a pull that registered
+            # after our check): sealed means done, unsealed means busy
+            if self.plasma.contains(oid):
+                return {"ok": True, "already": True}
+            return {"ok": False, "error": "object mid-transfer"}
         except PlasmaOOM:
             return {"ok": False, "error": "no plasma room"}
         self._recv[oid] = {
@@ -1387,7 +1398,25 @@ class NodeManager:
         try:
             dest = await self._plasma_create_with_room(oid, size)
         except FileExistsError:
-            return True
+            # A buffer already exists: a SEALED copy is success, but an
+            # inbound push mid-transfer is not — wait for it to seal
+            # instead of handing the caller a half-written object.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if self.plasma.contains(oid):
+                    return True
+                if oid not in self._recv:
+                    # transfer vanished (aborted): one shot at a clean redo
+                    try:
+                        dest = await self._plasma_create_with_room(oid, size)
+                        break
+                    except FileExistsError:
+                        return self.plasma.contains(oid)
+                    except PlasmaOOM:
+                        return False
+                await asyncio.sleep(0.1)
+            else:
+                return False
         except PlasmaOOM:
             logger.warning("pull %s: no room even after spilling", oid.hex()[:12])
             return False

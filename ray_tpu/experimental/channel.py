@@ -421,14 +421,18 @@ class SocketChannel:
         header = struct.pack("<QIQ", self._seq,
                              _FLAG_ERROR if is_error else 0, len(blob))
         for ridx, conn in list(self._conns.items()):
-            # honor the caller's deadline during the send too: a reader
-            # stalled with a full kernel buffer must not block forever. A
-            # timeout mid-frame is unrecoverable for this stream
-            # (sendall may have written part of the frame) -> ChannelClosed.
-            conn.settimeout(
-                None if deadline is None
-                else max(0.01, deadline - time.monotonic())
+            # Honor the caller's deadline during the send too: a reader
+            # stalled with a full kernel buffer must not block forever.
+            # A deadline that is ALREADY spent raises retryable
+            # TimeoutError before any bytes go out; a timeout mid-frame is
+            # unrecoverable for this stream (sendall may have written part
+            # of the frame) -> ChannelClosed.
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
             )
+            if remaining is not None and remaining <= 0.05:
+                raise TimeoutError("channel write timed out before send")
+            conn.settimeout(remaining)
             try:
                 conn.sendall(header + blob)
             except TimeoutError:

@@ -6,4 +6,5 @@ over a jax device mesh (north-star config 3: CPU rollouts + TPU learner).
 """
 
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401

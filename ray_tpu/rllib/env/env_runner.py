@@ -34,9 +34,11 @@ class SingleAgentEnvRunner:
         return (int(np.prod(self.envs.single_observation_space.shape)),
                 int(self.envs.single_action_space.n))
 
-    def sample(self, params, rollout_len: int) -> Dict[str, np.ndarray]:
-        """Collect rollout_len steps per env; returns a flat batch with GAE
-        advantages/returns plus completed-episode stats."""
+    def _rollout(self, params, rollout_len: int) -> Dict[str, np.ndarray]:
+        """Shared env-stepping core: time-major buffers for rollout_len
+        steps per env (policy forward, vector step, episode bookkeeping).
+        Both the on-policy (GAE) and off-policy (v-trace) samplers build on
+        this."""
         T, N = rollout_len, self.num_envs
         obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
         act_buf = np.zeros((T, N), np.int64)
@@ -61,6 +63,20 @@ class SingleAgentEnvRunner:
                 self._completed.append(float(self._episode_returns[i]))
                 self._episode_returns[i] = 0.0
             self.obs = nxt
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "rewards": rew_buf, "values": val_buf, "dones": done_buf,
+        }
+
+    def sample(self, params, rollout_len: int) -> Dict[str, np.ndarray]:
+        """Collect rollout_len steps per env; returns a flat batch with GAE
+        advantages/returns plus completed-episode stats."""
+        T, N = rollout_len, self.num_envs
+        roll = self._rollout(params, rollout_len)
+        obs_buf, act_buf, logp_buf = roll["obs"], roll["actions"], roll["logp"]
+        rew_buf, val_buf, done_buf = (
+            roll["rewards"], roll["values"], roll["dones"]
+        )
         _, last_v = numpy_forward(params, self.obs)
         adv = np.zeros((T, N), np.float32)
         lastgae = np.zeros(N, np.float32)
@@ -78,6 +94,23 @@ class SingleAgentEnvRunner:
             "logp_old": flat(logp_buf),
             "advantages": flat(adv),
             "returns": flat(returns),
+            "episode_returns": np.asarray(self._completed, np.float32),
+        }
+
+
+    def sample_trajectory(self, params, rollout_len: int) -> Dict[str, np.ndarray]:
+        """Time-major trajectory WITHOUT advantage processing — the
+        off-policy learner (IMPALA v-trace) needs raw sequences plus the
+        behavior policy's log-probs (reference:
+        rllib/algorithms/impala — decoupled sampling)."""
+        roll = self._rollout(params, rollout_len)
+        return {
+            "obs": roll["obs"],
+            "actions": roll["actions"],
+            "behavior_logp": roll["logp"],
+            "rewards": roll["rewards"],
+            "dones": roll["dones"],
+            "bootstrap_obs": self.obs.astype(np.float32),
             "episode_returns": np.asarray(self._completed, np.float32),
         }
 

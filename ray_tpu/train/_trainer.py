@@ -300,8 +300,15 @@ class DataParallelTrainer:
                 # Pace the polls even while reports flow: draining in a
                 # tight RPC loop steals the worker's GIL from the train
                 # thread's jax dispatch (measured 2.5x dispatch slowdown).
-                # The pipeline absorbs a 25ms consumption latency for free.
-                time.sleep(0.025 if got_any else 0.05)
+                # A deep pipeline (Train workers, depth 64) absorbs a 100 ms
+                # consumption latency for free and every poll RPC costs the
+                # worker two thread wakeups mid-dispatch, so poll at 10 Hz
+                # there; shallow pipelines (Tune trials) keep the snappier
+                # 25/50 ms cadence for per-report scheduler decisions.
+                if self._report_pipeline_depth >= 16:
+                    time.sleep(0.1 if got_any else 0.15)
+                else:
+                    time.sleep(0.025 if got_any else 0.05)
         # release the final acks so the workers' sessions unblock cleanly
         for i, n in pending_ack.items():
             if n and i < group.num_workers:

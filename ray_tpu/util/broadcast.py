@@ -84,18 +84,27 @@ async def _broadcast(worker, ref, node_ids, timeout):
         async def push(src, dst):
             info = alive[src]
             client = await worker.pool.get(info["ip"], info["raylet_port"])
-            r = await client.call(
-                "PushObject",
-                {"object_id": oid.binary(), "target": dst,
-                 "owner_addr": owner_addr},
-                timeout=timeout,
-            )
-            if not r.get("ok"):
-                raise RuntimeError(
-                    f"push {src.hex()[:8]}->{dst.hex()[:8]} failed: "
-                    f"{r.get('error')}"
+            for attempt in range(4):
+                r = await client.call(
+                    "PushObject",
+                    {"object_id": oid.binary(), "target": dst,
+                     "owner_addr": owner_addr},
+                    timeout=timeout,
                 )
-            return dst
+                if r.get("ok"):
+                    return dst
+                # a concurrent pull/push for the same object on the target
+                # is transient — let it finish and re-check
+                if "progress" in str(r.get("error", "")) or "transfer" in str(
+                    r.get("error", "")
+                ):
+                    await asyncio.sleep(0.5 * (attempt + 1))
+                    continue
+                break
+            raise RuntimeError(
+                f"push {src.hex()[:8]}->{dst.hex()[:8]} failed: "
+                f"{r.get('error')}"
+            )
 
         done = await asyncio.gather(*(push(s, d) for s, d in wave))
         transfers.extend(wave)

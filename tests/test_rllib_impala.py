@@ -1,0 +1,126 @@
+"""IMPALA: async decoupled sampling + v-trace learner (reference:
+rllib/algorithms/impala/ — threshold learning test like the PPO one)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import IMPALAConfig
+
+
+@pytest.fixture(scope="module")
+def rl_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_vtrace_reduces_to_gae_free_onpolicy():
+    """With pi == mu (rho = c = 1), v-trace targets reduce to n-step TD(lambda=1)
+    returns; check against a plain discounted-return rollup on a toy sequence."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.impala_learner import vtrace
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    boot = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    gamma = 0.9
+    discounts = jnp.full((T, N), gamma)
+    ones = jnp.ones((T, N))
+    vs, pg_adv = vtrace(ones, rewards, discounts, values, boot, ones)
+
+    # reference: vs_t = r_t + gamma * vs_{t+1}, vs_T = r_T + gamma * boot
+    expect = np.zeros((T, N), np.float32)
+    acc = np.asarray(boot)
+    for t in reversed(range(T)):
+        acc = np.asarray(rewards[t]) + gamma * acc
+        expect[t] = acc
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5)
+    # pg advantage at on-policy: r + gamma*vs_{t+1} - V(t)
+    vs_next = np.concatenate([expect[1:], np.asarray(boot)[None]], 0)
+    np.testing.assert_allclose(
+        np.asarray(pg_adv),
+        np.asarray(rewards) + gamma * vs_next - np.asarray(values),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_trajectory_sampler_shapes(rl_cluster):
+    from ray_tpu.rllib.core.rl_module import ActorCriticModule
+    from ray_tpu.rllib.env.env_runner import EnvRunnerGroup
+
+    group = EnvRunnerGroup("CartPole-v1", num_runners=1,
+                           num_envs_per_runner=3, gamma=0.99, lambda_=1.0)
+    obs_dim, num_actions = group.obs_and_action_dims()
+    import jax
+
+    params = jax.tree.map(
+        np.asarray, ActorCriticModule(num_actions=2).init_params(obs_dim)
+    )
+    batch = ray_tpu.get(
+        group.runners[0].sample_trajectory.remote(params, 16)
+    )
+    assert batch["obs"].shape == (16, 3, 4)
+    assert batch["behavior_logp"].shape == (16, 3)
+    assert batch["bootstrap_obs"].shape == (3, 4)
+    group.shutdown()
+
+
+def test_impala_cartpole_learns(rl_cluster):
+    """Learning threshold on CartPole with the async engine: decoupled
+    runners + continuous v-trace updates on the 8-device mesh learner."""
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=4, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .training(lr=3e-3, entropy_coeff=0.01, train_iter_env_steps=6144)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        assert algo.num_devices() == 8
+        best = 0.0
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 150:
+                break
+        assert best >= 150, f"IMPALA failed to learn CartPole: best={best:.1f}"
+        assert result["learner/learner_env_steps_per_s"] > 0
+        # async engine actually decoupled: more learner updates than
+        # training iterations x runners would allow synchronously
+        assert result["num_learner_updates"] >= result["training_iteration"]
+    finally:
+        algo.stop()
+
+
+def test_impala_save_restore(rl_cluster):
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=2,
+                     rollout_fragment_length=8)
+        .training(train_iter_env_steps=32)
+        .build()
+    )
+    try:
+        algo.train()
+        path = algo.save()
+        w0 = algo.get_weights()
+        from ray_tpu.rllib import IMPALA
+
+        algo2 = IMPALA.from_checkpoint(path)
+        try:
+            w1 = algo2.get_weights()
+            import jax
+
+            for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
