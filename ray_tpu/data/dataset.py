@@ -208,31 +208,15 @@ class Dataset:
 
     def sort(self, key: Union[str, Callable, None] = None,
              descending: bool = False) -> "Dataset":
-        """Materializing global sort (reference: Dataset.sort; the reference
-        does a distributed sample-sort — at our block counts a single
-        concat+argsort is both simpler and faster)."""
-        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
-        whole = concat_blocks(blocks)
-        n = block_num_rows(whole)
-        if n == 0:
-            return Dataset([])
-        if isinstance(whole, dict):
-            if key is None:
-                key = next(iter(whole))
-            order = np.argsort(np.asarray(whole[key]), kind="stable")
-            if descending:
-                order = order[::-1]
-            out: Block = {k: np.asarray(v)[order] for k, v in whole.items()}
-        else:
-            if key is None and whole and isinstance(whole[0], dict):
-                key = next(iter(whole[0]))  # match columnar default
-            if isinstance(key, str):
-                # row-oriented blocks: a string key selects the column
-                import operator
+        """Distributed sample-sort (reference: Dataset.sort via
+        data/_internal/planner/exchange/sort_task_spec.py): sample keys →
+        range-partition map tasks → per-partition sort-merge tasks. The
+        driver handles only key samples and boundary values, so datasets
+        larger than driver memory sort fine."""
+        from ray_tpu.data._exchange import distributed_sort
 
-                key = operator.itemgetter(key)
-            out = sorted(whole, key=key, reverse=descending)
-        return Dataset([ray_tpu.put(out)])
+        refs = list(self._iter_block_refs())
+        return Dataset(distributed_sort(refs, key, descending))
 
     def unique(self, column: str) -> List[Any]:
         vals = set()
@@ -490,57 +474,31 @@ class Dataset:
 
 
 class GroupedData:
-    """Hash-group aggregation on column blocks
-    (reference: python/ray/data/grouped_data.py — the aggregate subset)."""
-
-    _AGGS = {
-        "count": lambda v: len(v),
-        "sum": lambda v: np.sum(v).item(),
-        "mean": lambda v: np.mean(v).item(),
-        "min": lambda v: np.min(v).item(),
-        "max": lambda v: np.max(v).item(),
-        "std": lambda v: np.std(v, ddof=1).item() if len(v) > 1 else 0.0,
-    }
+    """Group aggregation over the distributed sample-sort exchange
+    (reference: python/ray/data/grouped_data.py over
+    exchange/sort_task_spec.py): range-partitioning by the group key puts
+    every row of a key into exactly one partition, so per-partition
+    aggregation tasks are exact and nothing materializes on the driver."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _grouped(self):
-        whole = concat_blocks(
-            [ray_tpu.get(r) for r in self._ds._iter_block_refs()]
-        )
-        if block_num_rows(whole) == 0:
-            # concat of zero blocks is [] regardless of block kind
-            whole = {self._key: np.array([])}
-        if not isinstance(whole, dict):
-            raise TypeError("groupby requires column blocks")
-        keys = np.asarray(whole[self._key])
-        order = np.argsort(keys, kind="stable")
-        sorted_keys = keys[order]
-        uniq, starts = np.unique(sorted_keys, return_index=True)
-        bounds = list(starts) + [len(sorted_keys)]
-        return whole, order, uniq, bounds
+    def _agg(self, column: Optional[str], how: str) -> Dataset:
+        from ray_tpu.data._exchange import distributed_group_agg
 
-    def _agg(self, column: str, how: str) -> Dataset:
-        whole, order, uniq, bounds = self._grouped()
-        if len(uniq) == 0:
+        refs = list(self._ds._iter_block_refs())
+        if not refs:
+            name = f"{how}({column})" if column else f"{how}()"
             return Dataset([ray_tpu.put({
-                self._key: uniq, f"{how}({column})": np.array([]),
+                self._key: np.array([]), name: np.array([]),
             })])
-        vals = np.asarray(whole[column])[order]
-        fn = self._AGGS[how]
-        out = [fn(vals[bounds[i]:bounds[i + 1]]) for i in range(len(uniq))]
-        return Dataset([ray_tpu.put({
-            self._key: uniq, f"{how}({column})": np.asarray(out),
-        })])
+        return Dataset(
+            distributed_group_agg(refs, self._key, column, how)
+        )
 
     def count(self) -> Dataset:
-        whole, order, uniq, bounds = self._grouped()
-        out = [bounds[i + 1] - bounds[i] for i in range(len(uniq))]
-        return Dataset([ray_tpu.put({
-            self._key: uniq, "count()": np.asarray(out),
-        })])
+        return self._agg(None, "count")
 
     def sum(self, column: str) -> Dataset:
         return self._agg(column, "sum")
@@ -558,13 +516,11 @@ class GroupedData:
         return self._agg(column, "std")
 
     def map_groups(self, fn: Callable) -> Dataset:
-        """Apply fn to each group's sub-block; concat the results."""
-        whole, order, uniq, bounds = self._grouped()
-        if len(uniq) == 0:
+        """Apply fn to each group's sub-block; concat per partition
+        (groups never split across partitions)."""
+        from ray_tpu.data._exchange import distributed_group_map
+
+        refs = list(self._ds._iter_block_refs())
+        if not refs:
             return Dataset([])
-        sorted_block = {k: np.asarray(v)[order] for k, v in whole.items()}
-        outs = []
-        for i in range(len(uniq)):
-            sub = slice_block(sorted_block, bounds[i], bounds[i + 1])
-            outs.append(fn(sub))
-        return Dataset([ray_tpu.put(o) for o in outs])
+        return Dataset(distributed_group_map(refs, self._key, fn))

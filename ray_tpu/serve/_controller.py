@@ -30,6 +30,31 @@ class ServeController:
         self._loop_task = None
         # replica name -> (last push ts, meta) — pushed by the replicas
         self._metrics: Dict[str, tuple] = {}
+        # long-poll config push (reference: serve/_private/long_poll.py):
+        # handles block on poll_replica_names until the replica set changes
+        self._versions: Dict[str, int] = {}
+        self._change_events: Dict[str, asyncio.Event] = {}
+        self._last_sets: Dict[str, tuple] = {}
+
+    def _bump_version(self, dep_name: str):
+        self._versions[dep_name] = self._versions.get(dep_name, 0) + 1
+        ev = self._change_events.pop(dep_name, None)
+        if ev is not None:
+            ev.set()
+
+    def _notify_changes(self):
+        """Detect replica-set changes and wake long-pollers."""
+        seen = set()
+        for dep_name, st in self._deployments.items():
+            seen.add(dep_name)
+            cur = tuple(sorted(st["replicas"].keys()))
+            if cur != self._last_sets.get(dep_name):
+                self._last_sets[dep_name] = cur
+                self._bump_version(dep_name)
+        for dep_name in list(self._last_sets):
+            if dep_name not in seen:
+                del self._last_sets[dep_name]
+                self._bump_version(dep_name)
 
     def _ensure_loop(self):
         if self._loop_task is None:
@@ -82,6 +107,7 @@ class ServeController:
                 "spec": spec,
                 "target": target,
                 "replicas": (st or {}).get("replicas", {}),  # name -> rec
+                "draining": (st or {}).get("draining", {}),
                 "next_id": (st or {}).get("next_id", 0),
                 "overload_since": None,
                 "underload_since": None,
@@ -110,13 +136,16 @@ class ServeController:
             st = self._deployments.get(dep_name)
             if st is None or dep_name in in_use:
                 continue
-            for rname, rec in st["replicas"].items():
+            for rname, rec in {
+                **st["replicas"], **st.get("draining", {})
+            }.items():
                 self._metrics.pop(rname, None)
                 try:
                     ray_tpu.kill(rec["handle"])
                 except Exception:
                     pass
             del self._deployments[dep_name]
+        self._notify_changes()
 
     async def report_replica_metrics(self, dep_name: str, replica_name: str, meta: dict):
         self._metrics[replica_name] = (time.time(), meta)
@@ -128,6 +157,30 @@ class ServeController:
         if st is None:
             return []
         return list(st["replicas"].keys())
+
+    async def poll_replica_names(self, deployment_name: str,
+                                 known_version: int = -1,
+                                 timeout: float = 25.0) -> dict:
+        """Long-poll: reply immediately when the caller's view is stale,
+        otherwise hold the call until the replica set changes (or the
+        timeout passes) — handles track replica churn push-style instead
+        of polling a TTL cache (reference: serve/_private/long_poll.py)."""
+        deadline = time.time() + timeout
+        while True:
+            v = self._versions.get(deployment_name, 0)
+            names = await self.get_replica_names(deployment_name)
+            if v != known_version:
+                return {"version": v, "names": names}
+            left = deadline - time.time()
+            if left <= 0:
+                return {"version": v, "names": names}
+            ev = self._change_events.setdefault(
+                deployment_name, asyncio.Event()
+            )
+            try:
+                await asyncio.wait_for(ev.wait(), left)
+            except asyncio.TimeoutError:
+                pass
 
     async def get_app_info(self, name: str) -> Optional[dict]:
         return self._apps.get(name)
@@ -223,11 +276,15 @@ class ServeController:
                     ray_tpu.remote(Replica)
                     .options(
                         name=rname,
-                        max_concurrency=spec.get("max_ongoing_requests", 8),
+                        # +8 headroom over the user-request cap (which the
+                        # replica self-gates): queue_len probes and metrics
+                        # answer instantly even at saturation
+                        max_concurrency=spec.get("max_ongoing_requests", 8) + 8,
                         **opts,
                     )
                     .remote(
-                        {"callable": spec["callable"], "name": dep_name},
+                        {"callable": spec["callable"], "name": dep_name,
+                         "max_ongoing": spec.get("max_ongoing_requests", 8)},
                         spec.get("init_args", ()),
                         spec.get("init_kwargs", {}),
                     )
@@ -240,14 +297,37 @@ class ServeController:
                     "created": now,
                     "version": spec["version"],
                 }
+            # Scale-down drains gracefully: the replica leaves the
+            # advertised set FIRST (long-pollers re-route within one poll),
+            # then dies once its in-flight requests finish (or after a
+            # 30 s grace) — a scale-down must not fail live requests.
+            draining = st.setdefault("draining", {})
             while len(st["replicas"]) > st["target"]:
                 rname = next(iter(st["replicas"]))
                 rec = st["replicas"].pop(rname)
-                self._metrics.pop(rname, None)
-                try:
-                    ray_tpu.kill(rec["handle"])
-                except Exception:
-                    pass
+                rec["drain_started"] = now
+                rec["drain_deadline"] = now + 30.0
+                draining[rname] = rec
+            for rname in list(draining):
+                rec = draining[rname]
+                pushed = self._metrics.get(rname)
+                # Idle only counts from a push that POSTDATES the drain
+                # start by a push period: a pre-drain ongoing=0 snapshot
+                # says nothing about requests dispatched by handles that
+                # had not yet seen the set change.
+                idle = (
+                    pushed is not None
+                    and pushed[0] > rec["drain_started"] + 2.5
+                    and pushed[1].get("ongoing", 1) == 0
+                )
+                if idle or now > rec["drain_deadline"]:
+                    draining.pop(rname)
+                    self._metrics.pop(rname, None)
+                    try:
+                        ray_tpu.kill(rec["handle"])
+                    except Exception:
+                        pass
+        self._notify_changes()
 
     @staticmethod
     def _actor_pending(replica_name: str) -> bool:

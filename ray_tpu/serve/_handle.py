@@ -3,10 +3,13 @@
 Counterpart of the reference's handle → router → replica-scheduler chain
 (reference: python/ray/serve/handle.py:714 DeploymentHandle,
 _private/router.py:320, _private/replica_scheduler/pow_2_scheduler.py:49
-PowerOfTwoChoicesReplicaScheduler). Replica sets are fetched from the
-controller and cached briefly; each call picks the less-loaded of two
-random replicas using handle-local in-flight counts (the reference's
-client-side queue-length view).
+PowerOfTwoChoicesReplicaScheduler). Replica-set changes arrive by
+LONG-POLL push from the controller (reference: _private/long_poll.py) — a
+background updater holds a poll open and applies new sets the moment the
+controller reconciles, so scale-downs re-route within one poll instead of
+a TTL window. Each call picks two random replicas and PROBES their actual
+queue depths (pow-2 with probes, like the reference's scheduler), falling
+back to handle-local in-flight counts when a probe times out.
 """
 
 from __future__ import annotations
@@ -17,7 +20,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
-_REPLICA_CACHE_TTL_S = 1.0
+_POLL_TIMEOUT_S = 20.0
+_PROBE_TIMEOUT_S = 0.5
 
 
 class DeploymentResponse:
@@ -98,8 +102,11 @@ class DeploymentHandle:
         self._stream = stream
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
-        self._fetched_at = 0.0
-        self._inflight: Dict[int, int] = {}  # replica index -> in-flight
+        self._replica_names: List[str] = []
+        self._version = -1
+        self._inflight: Dict[str, int] = {}  # replica name -> in-flight
+        self._poller: Optional[threading.Thread] = None
+        self._closed = False
 
     def __reduce__(self):
         return (DeploymentHandle,
@@ -123,33 +130,89 @@ class DeploymentHandle:
         return DeploymentHandle(self.deployment_name, name, self._model_id,
                                 self._stream)
 
-    def _refresh_replicas(self, force: bool = False):
-        now = time.time()
-        with self._lock:
-            if not force and self._replicas and now - self._fetched_at < _REPLICA_CACHE_TTL_S:
-                return
+    def _apply_names(self, names: List[str], version: int):
         import ray_tpu
 
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        names = ray_tpu.get(
-            controller.get_replica_names.remote(self.deployment_name), timeout=30
-        )
         replicas = []
+        kept = []
         for n in names:
             try:
                 replicas.append(ray_tpu.get_actor(n))
+                kept.append(n)
             except Exception:
                 pass
         with self._lock:
             self._replicas = replicas
-            self._fetched_at = now
-            self._inflight = {i: 0 for i in range(len(replicas))}
+            self._replica_names = kept
+            self._version = version
+            # in-flight counts keyed by NAME so surviving replicas keep
+            # their counts across set changes
+            self._inflight = {
+                n: self._inflight.get(n, 0) for n in kept
+            }
+
+    def _poll_loop(self):
+        """Background long-poll: applies replica-set changes the moment
+        the controller publishes them. The thread is bound to ONE runtime
+        session — after ray_tpu.shutdown (tests, notebooks) it retires
+        instead of polling a dead or unrelated cluster; the next call on
+        the handle starts a fresh poller in the new session."""
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        my_worker = worker_mod.global_worker
+        try:
+            while not self._closed:
+                if worker_mod.global_worker is not my_worker:
+                    return
+                try:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    r = ray_tpu.get(
+                        controller.poll_replica_names.remote(
+                            self.deployment_name, self._version,
+                            _POLL_TIMEOUT_S,
+                        ),
+                        timeout=_POLL_TIMEOUT_S + 15,
+                    )
+                    if r["version"] != self._version or not self._replicas:
+                        self._apply_names(r["names"], r["version"])
+                except Exception:
+                    for _ in range(10):
+                        if (self._closed
+                                or worker_mod.global_worker is not my_worker):
+                            return
+                        time.sleep(0.1)
+        finally:
+            with self._lock:
+                if self._poller is threading.current_thread():
+                    self._poller = None
+
+    def _refresh_replicas(self, force: bool = False):
+        with self._lock:
+            if self._poller is None and not self._closed:
+                self._poller = threading.Thread(
+                    target=self._poll_loop, daemon=True,
+                    name=f"serve-poll-{self.deployment_name}",
+                )
+                self._poller.start()
+        if force or not self._replicas:
+            import ray_tpu
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            r = ray_tpu.get(
+                controller.poll_replica_names.remote(
+                    self.deployment_name, -1, 0.0
+                ),
+                timeout=30,
+            )
+            self._apply_names(r["names"], r["version"])
 
     def _pick(self) -> tuple:
-        """Power-of-two-choices on handle-local in-flight counts; requests
-        tagged with a multiplexed model id get deterministic model→replica
-        affinity instead, so each model's weights stay warm on one replica
-        (reference: pow_2_scheduler.py multiplexed-model ranking)."""
+        """Power-of-two-choices with queue-length probes: two random
+        candidates report their actual in-flight depth (reference:
+        pow_2_scheduler.py:49); handle-local counts break probe failures
+        and ties. Multiplexed requests get deterministic model→replica
+        affinity so each model's weights stay warm on one replica."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -157,21 +220,44 @@ class DeploymentHandle:
                     f"no replicas for deployment '{self.deployment_name}'"
                 )
             if n == 1:
-                idx = 0
+                cand = [0]
             elif self._model_id:
                 import zlib
 
-                idx = zlib.crc32(self._model_id.encode()) % n
+                cand = [zlib.crc32(self._model_id.encode()) % n]
             else:
-                a, b = random.sample(range(n), 2)
-                idx = a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            return idx, self._replicas[idx]
+                cand = random.sample(range(n), 2)
+            cand_named = [
+                (i, self._replica_names[i], self._replicas[i]) for i in cand
+            ]
+        if len(cand_named) == 1:
+            idx, name, replica = cand_named[0]
+        else:
+            import ray_tpu
 
-    def _done(self, idx: int):
+            try:
+                depths = ray_tpu.get(
+                    [r.queue_len.remote() for _, _, r in cand_named],
+                    timeout=_PROBE_TIMEOUT_S,
+                )
+            except Exception:
+                with self._lock:
+                    depths = [
+                        self._inflight.get(nm, 0) for _, nm, _ in cand_named
+                    ]
+            pick = min(range(len(cand_named)), key=lambda i: depths[i])
+            idx, name, replica = cand_named[pick]
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+        return name, replica
+
+    def _done(self, name: str):
+        with self._lock:
+            if self._inflight.get(name, 0) > 0:
+                self._inflight[name] -= 1
+
+    def close(self):
+        self._closed = True
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         deadline = time.time() + 60
@@ -208,6 +294,9 @@ class DeploymentHandle:
                 return resp
             except Exception as e:
                 last_err = e
+                # the pick's in-flight increment must not outlive a failed
+                # dispatch (counts persist across set refreshes now)
+                self._done(idx)
                 self._refresh_replicas(force=True)
         raise RuntimeError(
             f"could not reach any replica of '{self.deployment_name}': {last_err}"

@@ -39,17 +39,31 @@ class Replica:
             self._is_function = True
         self._ongoing = 0
         self._handled = 0
+        # User-request concurrency is self-gated so the actor's
+        # max_concurrency can carry headroom for control-plane methods
+        # (queue_len probes, metrics) — a saturated replica must still
+        # answer probes instantly (reference: pow_2_scheduler probes).
+        self._max_ongoing = serialized.get("max_ongoing", 8)
+        self._sem = None  # lazy: created on the actor loop
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         import asyncio
         import functools
 
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self._max_ongoing)
         model_id = kwargs.pop("__multiplexed_model_id", "")
         if model_id:
             from ray_tpu.serve.multiplex import _set_current_model_id
 
             _set_current_model_id(model_id)
+        # _ongoing counts queued + running: the probe's notion of depth
         self._ongoing += 1
+        try:
+            await self._sem.acquire()
+        except BaseException:
+            self._ongoing -= 1
+            raise
         try:
             if self._is_function:
                 target = self._callable
@@ -75,6 +89,7 @@ class Replica:
                 result = await result
             return result
         finally:
+            self._sem.release()
             self._ongoing -= 1
             self._handled += 1
 
@@ -181,6 +196,11 @@ class Replica:
 
     def get_metadata(self) -> dict:
         return {"ongoing": self._ongoing, "handled": self._handled}
+
+    async def queue_len(self) -> int:
+        """Current in-flight count, probed by pow-2 routing (reference:
+        replica_scheduler/pow_2_scheduler.py:49 queue-length probes)."""
+        return self._ongoing
 
     async def start_metrics_push(
         self, replica_name: str, health_check_period_s: float = 2.0
