@@ -100,6 +100,8 @@ class NodeManager:
         # object pulls in flight: object_id bytes -> asyncio.Event
         self._pulls: Dict[bytes, asyncio.Event] = {}
         self._recv: Dict[bytes, dict] = {}  # inbound pushes mid-transfer
+        self._venv_locks: Dict[str, asyncio.Lock] = {}
+        self._venv_jobs: Dict[str, set] = {}  # venv hash -> jobs using it
         # pinned primary copies: object_id bytes -> memoryview
         self._pinned: Dict[bytes, memoryview] = {}
         # spilled primaries: object_id bytes -> (path, size). A spilled object
@@ -113,6 +115,12 @@ class NodeManager:
         # worker_id -> reason, for deaths we caused (OOM kills)
         self._kill_reasons: Dict[bytes, str] = {}
         self._bg = []
+        try:
+            import psutil
+
+            psutil.cpu_percent(interval=None)  # prime: first call reads 0.0
+        except Exception:
+            pass
 
     # ------------------------------------------------------------- lifecycle
 
@@ -208,6 +216,33 @@ class NodeManager:
              sum(size for _, size in self._spilled.values()))
         )
         samples.append(("ray_tpu_pulls_in_flight", {"node": node}, len(self._pulls)))
+        # per-node host stats (reference: dashboard reporter_agent.py:314
+        # psutil cpu/mem/per-worker probes)
+        try:
+            import psutil
+
+            samples.append(
+                ("ray_tpu_node_cpu_percent", {"node": node},
+                 psutil.cpu_percent(interval=None))
+            )
+            vm = psutil.virtual_memory()
+            samples.append(
+                ("ray_tpu_node_mem_used_bytes", {"node": node}, vm.used)
+            )
+            samples.append(
+                ("ray_tpu_node_mem_total_bytes", {"node": node}, vm.total)
+            )
+            for h in self.worker_pool.workers.values():
+                try:
+                    rss = psutil.Process(h.pid).memory_info().rss
+                except Exception:
+                    continue
+                samples.append(
+                    ("ray_tpu_worker_rss_bytes",
+                     {"node": node, "pid": str(h.pid)}, rss)
+                )
+        except Exception:
+            pass
         return render_prometheus(samples)
 
     async def _heartbeat_loop(self):
@@ -533,7 +568,9 @@ class NodeManager:
                 )}
 
         try:
-            env_overrides = await self._runtime_env_overrides(req.get("runtime_env"))
+            env_overrides = await self._runtime_env_overrides(
+                req.get("runtime_env"), req.get("job_id", b"")
+            )
         except Exception as e:
             return {"error": f"runtime_env setup failed: {e}"}
 
@@ -672,7 +709,9 @@ class NodeManager:
         if grant is None:
             return {"granted": False}
         try:
-            env = await self._runtime_env_overrides(req.get("runtime_env"))
+            env = await self._runtime_env_overrides(
+                req.get("runtime_env"), req.get("job_id", b"")
+            )
         except Exception as e:
             pool, _ = self._pool_for(req.get("strategy", {}))
             pool.release(grant["demand"])
@@ -706,10 +745,30 @@ class NodeManager:
             "lease_id": lease_id,
         }
 
-    async def _runtime_env_overrides(self, runtime_env) -> Dict[str, str]:
+    async def _materialize_uri(self, uri: str) -> str:
+        """Fetch + extract a kv:<hash> packaged directory (idempotent)."""
+        base = self.session_dir or "."
+        target = renv.materialized_path(uri, base)
+        if os.path.isdir(target):
+            return target
+        digest = uri[len(renv.URI_PREFIX):]
+        r = await self.gcs.call(
+            "KVGet", {"ns": renv.KV_NAMESPACE, "key": digest.encode()}
+        )
+        blob = r.get("value")
+        if blob is None:
+            raise RuntimeError(f"runtime_env package {uri} missing from GCS KV")
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, renv.extract_working_dir, uri, blob, base
+        )
+
+    async def _runtime_env_overrides(self, runtime_env,
+                                     job_id: bytes = b"") -> Dict[str, str]:
         """Turn a spec's runtime_env into worker env overrides, extracting an
-        uploaded working_dir on first use (reference: the per-node
-        runtime-env agent, _private/runtime_env/agent/runtime_env_agent.py)."""
+        uploaded working_dir / py_modules and building pip venvs on first
+        use (reference: the per-node runtime-env agent,
+        _private/runtime_env/agent/runtime_env_agent.py + pip.py)."""
         env: Dict[str, str] = {}
         if not runtime_env:
             return env
@@ -718,25 +777,72 @@ class NodeManager:
         wd = runtime_env.get("working_dir")
         if wd:
             if renv.is_uploaded(wd):
-                base = self.session_dir or "."
-                target = renv.materialized_path(wd, base)
-                if not os.path.isdir(target):
-                    digest = wd[len(renv.URI_PREFIX):]
-                    r = await self.gcs.call(
-                        "KVGet", {"ns": renv.KV_NAMESPACE, "key": digest.encode()}
-                    )
-                    blob = r.get("value")
-                    if blob is None:
-                        raise RuntimeError(f"working_dir {wd} missing from GCS KV")
-                    loop = asyncio.get_running_loop()
-                    target = await loop.run_in_executor(
-                        None, renv.extract_working_dir, wd, blob, base
-                    )
-                env[renv.WORKING_DIR_ENV] = target
+                env[renv.WORKING_DIR_ENV] = await self._materialize_uri(wd)
             else:
                 # Raw local path (same-machine clusters / tests).
                 env[renv.WORKING_DIR_ENV] = str(wd)
+        pypath: list = []
+        for mod in runtime_env.get("py_modules") or []:
+            if renv.is_uploaded(mod):
+                pypath.append(await self._materialize_uri(mod))
+            else:
+                pypath.append(str(mod))
+        pip = runtime_env.get("pip")
+        if pip:
+            pypath.append(await self._ensure_pip_env(pip, job_id))
+        if pypath:
+            env["RTPU_PYPATH_PREPEND"] = os.pathsep.join(pypath)
         return env
+
+    async def _ensure_pip_env(self, pip: dict, job_id: bytes) -> str:
+        """Per-spec-hash package dir built by `pip install --target`, shared
+        by every worker that asks for the same pip spec; reference-counted
+        per job and evicted when the last job using it finishes (reference:
+        runtime_env/agent/runtime_env_agent.py:162 + pip.py).
+
+        --target instead of a nested venv: the base interpreter is itself a
+        venv, and `python -m venv` from inside one resolves "system site
+        packages" to the ORIGINAL interpreter, hiding the baked-in stack.
+        A plain target dir prepended to sys.path adds packages on top of
+        the full base env — exactly the per-job-deps semantics wanted."""
+        import hashlib
+        import json as _json
+        import shutil
+        import subprocess
+        import sys as _sys
+
+        spec = _json.dumps(pip, sort_keys=True)
+        h = hashlib.sha1(spec.encode()).hexdigest()[:16]
+        base = os.path.join(self.session_dir or ".", "runtime_envs", "venvs")
+        env_dir = os.path.join(base, h)
+        marker = os.path.join(env_dir, ".rtpu_ready")
+        if job_id:
+            self._venv_jobs.setdefault(h, set()).add(job_id)
+        lock = self._venv_locks.setdefault(h, asyncio.Lock())
+        async with lock:
+            if not os.path.exists(marker):
+                loop = asyncio.get_running_loop()
+
+                def build():
+                    shutil.rmtree(env_dir, ignore_errors=True)  # half-built
+                    os.makedirs(base, exist_ok=True)
+                    cmd = [
+                        _sys.executable, "-m", "pip", "install",
+                        "--no-input", "--target", env_dir,
+                        *pip.get("pip_install_options", []),
+                        *pip["packages"],
+                    ]
+                    r = subprocess.run(cmd, capture_output=True, text=True)
+                    if r.returncode != 0:
+                        raise RuntimeError(
+                            f"pip install failed:\n{r.stdout[-2000:]}\n"
+                            f"{r.stderr[-2000:]}"
+                        )
+
+                await loop.run_in_executor(None, build)
+                with open(marker, "w") as f:
+                    f.write(spec)
+        return env_dir
 
     async def handle_KillWorker(self, req):
         handle = self.worker_pool.workers.get(req["worker_id"])
@@ -748,6 +854,26 @@ class NodeManager:
 
     async def handle_JobFinished(self, req):
         self.worker_pool.kill_job_workers(req["job_id"])
+        # evict pip venvs no job still references (reference: runtime_env
+        # agent deletes per-job URIs on job exit)
+        import shutil
+
+        job_id = req["job_id"]
+        loop = asyncio.get_running_loop()
+        for h, jobs in list(self._venv_jobs.items()):
+            jobs.discard(job_id)
+            if not jobs:
+                self._venv_jobs.pop(h, None)
+                self._venv_locks.pop(h, None)
+                path = os.path.join(
+                    self.session_dir or ".", "runtime_envs", "venvs", h
+                )
+                # rmtree of a big env off the loop: heartbeats/leases must
+                # not stall behind filesystem work
+                loop.run_in_executor(
+                    None, shutil.rmtree, path, True
+                )
+                logger.info("evicting pip venv %s (last job finished)", h)
 
     # ------------------------------------------------------ placement groups
 
@@ -1509,6 +1635,27 @@ class NodeManager:
                 }
             )
         return {"workers": workers}
+
+    async def handle_ProfileWorker(self, req):
+        """Proxy an on-demand profile request to one of this node's
+        workers, addressed by worker_id or pid (reference: dashboard
+        reporter agent routing, reporter_agent.py:314)."""
+        target = None
+        for h in self.worker_pool.workers.values():
+            if (req.get("worker_id") and h.worker_id == req["worker_id"]) or (
+                req.get("pid") and h.pid == req["pid"]
+            ):
+                target = h
+                break
+        if target is None or not target.addr[1]:
+            return {"error": "no such worker on this node"}
+        client = await self.pool.get(*target.addr)
+        r = await client.call(
+            "Profile",
+            {"duration": req.get("duration", 2.0), "hz": req.get("hz", 100.0)},
+            timeout=float(req.get("duration", 2.0)) + 30,
+        )
+        return r
 
     async def handle_Ping(self, req):
         return {"ok": True}

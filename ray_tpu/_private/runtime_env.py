@@ -24,8 +24,12 @@ _EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
 _MAX_WORKING_DIR_BYTES = 512 * 1024 * 1024
 
 
-def package_working_dir(path: str) -> bytes:
-    """Deterministically zip a local directory (stable hash for same content)."""
+def package_working_dir(path: str, arc_prefix: str = "") -> bytes:
+    """Deterministically zip a local directory (stable hash for same
+    content). arc_prefix nests entries under a directory inside the
+    archive — py_modules use the module dir's basename so the EXTRACTED
+    root is a sys.path entry from which `import <basename>` works
+    (reference py_modules contract)."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
         raise ValueError(f"runtime_env working_dir {path!r} is not a directory")
@@ -37,6 +41,8 @@ def package_working_dir(path: str) -> bytes:
             for fname in sorted(files):
                 full = os.path.join(root, fname)
                 rel = os.path.relpath(full, path)
+                if arc_prefix:
+                    rel = os.path.join(arc_prefix, rel)
                 try:
                     total += os.path.getsize(full)
                 except OSError:
@@ -54,9 +60,9 @@ def package_working_dir(path: str) -> bytes:
     return buf.getvalue()
 
 
-def upload_working_dir(gcs, path: str) -> str:
+def upload_working_dir(gcs, path: str, arc_prefix: str = "") -> str:
     """Zip + upload to the GCS KV; returns the kv:<hash> URI."""
-    blob = package_working_dir(path)
+    blob = package_working_dir(path, arc_prefix)
     digest = hashlib.sha1(blob).hexdigest()
     key = digest.encode()
     if not gcs.kv_exists(KV_NAMESPACE, key):

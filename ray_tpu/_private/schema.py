@@ -117,6 +117,8 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "PullObject": {"object_id": bytes, "owner_addr?": _addr},
     "GetLocalObjectInfo": {},
     "GetLocalWorkerInfo": {},
+    "ProfileWorker": {"worker_id?": bytes, "pid?": int,
+                      "duration?": _num, "hz?": _num},
     "Ping": {},
 }
 
@@ -134,6 +136,7 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "AddObjectLocation": {"object_id": bytes, "node_id": bytes},
     "RemoveObjectLocation": {"object_id": bytes, "node_id": bytes},
     "CancelTask": {"task_id": bytes, "force?": bool},
+    "Profile": {"duration?": _num, "hz?": _num},
     "KillActor": {"no_restart?": bool},
     "Exit": {},
     "Ping": {},

@@ -129,7 +129,32 @@ def scheduling_key(spec: dict) -> tuple:
     return (spec["fn_key"], res, strat, runtime_env_key(spec.get("runtime_env")))
 
 
-RUNTIME_ENV_SUPPORTED = ("env_vars", "working_dir")
+RUNTIME_ENV_SUPPORTED = ("env_vars", "working_dir", "pip", "py_modules")
+
+
+def normalize_pip(pip) -> Optional[dict]:
+    """Canonical pip spec: {"packages": [...], "pip_install_options": [...]}
+    (reference: _private/runtime_env/pip.py accepts a list or dict)."""
+    if pip is None:
+        return None
+    if isinstance(pip, (list, tuple)):
+        pip = {"packages": list(pip)}
+    if not isinstance(pip, dict) or not isinstance(pip.get("packages"), list):
+        raise ValueError(
+            "runtime_env pip must be a list of requirements or "
+            '{"packages": [...], "pip_install_options": [...]}'
+        )
+    unknown = set(pip) - {"packages", "pip_install_options"}
+    if unknown:
+        # silent drops are worse than errors (same rule as the top-level
+        # runtime_env fields)
+        raise ValueError(f"unsupported pip option(s): {sorted(unknown)}")
+    return {
+        "packages": [str(p) for p in pip["packages"]],
+        "pip_install_options": [
+            str(o) for o in pip.get("pip_install_options", [])
+        ],
+    }
 
 
 def runtime_env_key(runtime_env: Optional[dict]) -> str:
@@ -143,7 +168,9 @@ def runtime_env_key(runtime_env: Optional[dict]) -> str:
     env_vars = runtime_env.get("env_vars") or {}
     return json.dumps(
         {"env_vars": dict(sorted(env_vars.items())),
-         "working_dir": runtime_env.get("working_dir") or ""},
+         "working_dir": runtime_env.get("working_dir") or "",
+         "pip": runtime_env.get("pip") or None,
+         "py_modules": list(runtime_env.get("py_modules") or [])},
         sort_keys=True,
     )
 
@@ -151,10 +178,10 @@ def runtime_env_key(runtime_env: Optional[dict]) -> str:
 def validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
     """Reject unsupported runtime_env fields loudly.
 
-    The reference supports many plugins (pip/conda/container/... —
-    python/ray/_private/runtime_env/plugin.py); this framework implements
-    env_vars and working_dir. Accepting-and-ignoring an option would be a
-    silent no-op, which is worse than an error.
+    The reference supports many plugins (python/ray/_private/runtime_env/
+    plugin.py); this framework implements env_vars, working_dir, pip, and
+    py_modules. Accepting-and-ignoring an option would be a silent no-op,
+    which is worse than an error.
     """
     if not runtime_env:
         return runtime_env
@@ -173,4 +200,16 @@ def validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
     wd = runtime_env.get("working_dir")
     if wd is not None and not isinstance(wd, str):
         raise ValueError("runtime_env working_dir must be a path string")
-    return runtime_env
+    out = dict(runtime_env)
+    if "pip" in runtime_env:
+        out["pip"] = normalize_pip(runtime_env["pip"])
+    pm = runtime_env.get("py_modules")
+    if pm is not None:
+        if not isinstance(pm, (list, tuple)) or not all(
+            isinstance(p, str) for p in pm
+        ):
+            raise ValueError(
+                "runtime_env py_modules must be a list of directory paths"
+            )
+        out["py_modules"] = list(pm)
+    return out

@@ -999,16 +999,33 @@ class CoreWorker:
         runtime_env = ts.validate_runtime_env(runtime_env)
         if not runtime_env:
             return runtime_env
-        wd = runtime_env.get("working_dir")
-        if wd and not renv.is_uploaded(wd):
+
+        def upload_dir(path: str, arc_prefix: str = "") -> str:
             # Cache by content signature, not path: edits to the directory
             # between submits must produce a fresh upload.
-            cache_key = (os.path.abspath(wd), renv.dir_signature(wd))
+            cache_key = (
+                os.path.abspath(path), renv.dir_signature(path), arc_prefix
+            )
             uri = self._working_dir_uris.get(cache_key)
             if uri is None:
-                uri = renv.upload_working_dir(self.gcs, wd)
+                uri = renv.upload_working_dir(self.gcs, path, arc_prefix)
                 self._working_dir_uris[cache_key] = uri
-            runtime_env = {**runtime_env, "working_dir": uri}
+            return uri
+
+        wd = runtime_env.get("working_dir")
+        if wd and not renv.is_uploaded(wd):
+            runtime_env = {**runtime_env, "working_dir": upload_dir(wd)}
+        pm = runtime_env.get("py_modules")
+        if pm:
+            # py_modules ride the working_dir packaging machinery, nested
+            # under the module dir's basename so `import <basename>` works
+            # from the extracted root (reference: py_modules contract,
+            # runtime_env packaging.py)
+            runtime_env = {**runtime_env, "py_modules": [
+                p if renv.is_uploaded(p)
+                else upload_dir(p, os.path.basename(os.path.abspath(p)))
+                for p in pm
+            ]}
         return runtime_env
 
     def _replace_large_args(self, wire, large) -> List[ObjectRef]:
@@ -1969,6 +1986,19 @@ class CoreWorker:
         entry = self.memory_store.get_if_exists(oid)
         if isinstance(entry, InPlasma):
             entry.locations.discard(req["node_id"])
+
+    async def handle_Profile(self, req):
+        """On-demand stack sampling of THIS process (reference: dashboard
+        reporter profile_manager.py:78 py-spy; see _private/profiling.py)."""
+        from ray_tpu._private import profiling
+
+        loop = asyncio.get_running_loop()
+        counts = await loop.run_in_executor(
+            None, profiling.sample_stacks,
+            req.get("duration", 2.0), req.get("hz", 100.0),
+        )
+        return {"folded": profiling.folded_text(counts),
+                "samples": sum(counts.values()), "pid": os.getpid()}
 
     async def handle_CancelTask(self, req):
         self.executor.cancel(req["task_id"])

@@ -57,6 +57,18 @@ def _child_main(args, spawn):
             sys.path.insert(0, wd)
         except OSError:
             print(f"runtime_env: cannot enter working_dir {wd!r}", file=sys.stderr)
+    # runtime_env pip venvs + py_modules: the raylet materialized them and
+    # hands their import roots here; forked workers adopt them by sys.path
+    # (the venv shares this interpreter via --system-site-packages, so
+    # path adoption IS "running inside the venv" for import purposes).
+    pypath = os.environ.get("RTPU_PYPATH_PREPEND")
+    if pypath:
+        import importlib
+
+        for p in reversed(pypath.split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
+        importlib.invalidate_caches()
     # If jax was preimported (by us or a plugin), its platform config may
     # have been baked at import time — some platform plugins even force
     # their own value, ignoring the env. Re-sync from the (inherited +

@@ -88,6 +88,8 @@ class DashboardHead:
             return 200, {"version": version}
         if path.startswith("/api/logs"):
             return self._logs_api(path, query or {})
+        if path.startswith("/api/profile"):
+            return self._profile_api(query or {})
         if path.startswith("/api/jobs"):
             return self._jobs_api(path, method, body, query or {})
         if path == "/" or path == "/index.html":
@@ -122,6 +124,57 @@ class DashboardHead:
         except ValueError as e:
             return 404, {"error": str(e)}
         return 404, {"error": f"no route {path}"}
+
+    def _profile_api(self, query):
+        """GET /api/profile?pid=N[&node_id=hex][&duration=2][&hz=100]:
+        on-demand stack sampling of a worker process, flamegraph-folded
+        output (reference: dashboard reporter profile_manager.py:78 —
+        py-spy-shaped capability without the binary dependency)."""
+        pid = query.get("pid")
+        worker_id = query.get("worker_id")
+        if not pid and not worker_id:
+            return 400, {"error": "pass ?pid= or ?worker_id="}
+        duration = float(query.get("duration", 2.0) or 2.0)
+        hz = float(query.get("hz", 100.0) or 100.0)
+        req = {"duration": duration, "hz": hz}
+        if pid:
+            req["pid"] = int(pid)
+        if worker_id:
+            req["worker_id"] = bytes.fromhex(worker_id)
+        node_filter = query.get("node_id")
+        gcs = self._gcs_client()
+        nodes = [
+            n for n in gcs.get_all_node_info()
+            if n.get("state", "ALIVE") == "ALIVE"
+            and (not node_filter or n["node_id"].hex().startswith(node_filter))
+        ]
+        from ray_tpu._private.rpc import IoThread, RpcClient
+
+        io = IoThread.current()
+        last_err = None
+        for n in nodes:
+            async def ask(n=n):
+                client = RpcClient(n["ip"], n["raylet_port"])
+                await client.connect()
+                try:
+                    return await client.call(
+                        "ProfileWorker", req, timeout=duration + 40
+                    )
+                finally:
+                    await client.close()
+
+            try:
+                r = io.run(ask(), timeout=duration + 60)
+            except Exception as e:
+                # an unreachable raylet must not mask workers on the
+                # remaining nodes
+                last_err = str(e)
+                continue
+            if not r.get("error"):
+                return 200, r
+        if last_err:
+            return 502, {"error": f"some raylets unreachable: {last_err}"}
+        return 404, {"error": "no such worker on any alive node"}
 
     def _session_dir(self) -> str:
         """Cluster session dir from the GCS, cached (it never changes);

@@ -235,16 +235,30 @@ class DeploymentHandle:
         else:
             import ray_tpu
 
+            # probe candidates INDEPENDENTLY: one dead/slow replica must
+            # neither discard the live candidate's answer nor stall the
+            # request past the probe budget — an unanswered or failed
+            # probe falls back to the local count, and a probe that
+            # ERRORS (replica dead) is penalized so the live one wins
+            refs = [r.queue_len.remote() for _, _, r in cand_named]
             try:
-                depths = ray_tpu.get(
-                    [r.queue_len.remote() for _, _, r in cand_named],
-                    timeout=_PROBE_TIMEOUT_S,
+                ready, _ = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=_PROBE_TIMEOUT_S
                 )
+                ready_set = set(ready)
             except Exception:
+                ready_set = set()
+            depths = []
+            for ref, (_i, nm, _r) in zip(refs, cand_named):
+                if ref in ready_set:
+                    try:
+                        depths.append(ray_tpu.get(ref, timeout=1))
+                        continue
+                    except Exception:
+                        depths.append(1 << 30)  # dead replica: avoid
+                        continue
                 with self._lock:
-                    depths = [
-                        self._inflight.get(nm, 0) for _, nm, _ in cand_named
-                    ]
+                    depths.append(self._inflight.get(nm, 0))
             pick = min(range(len(cand_named)), key=lambda i: depths[i])
             idx, name, replica = cand_named[pick]
         with self._lock:

@@ -100,29 +100,50 @@ class Replica:
         generator; items are pulled in batches via next_stream_items
         (reference: serve's streaming responses, replica.py generator
         handling)."""
+        import asyncio
         import uuid
 
         import time as _time
 
-        model_id = kwargs.pop("__multiplexed_model_id", "")
-        if model_id:
-            from ray_tpu.serve.multiplex import _set_current_model_id
-
-            _set_current_model_id(model_id)
-        target = (self._callable if self._is_function
-                  else getattr(self._callable, method or "__call__"))
-        gen = target(*args, **kwargs)
-        if inspect.iscoroutine(gen):
-            gen = await gen
-        sid = uuid.uuid4().hex
-        if not hasattr(self, "_streams"):
-            self._streams = {}
-        # model_id stored with the stream: the generator body executes in
-        # next_stream_items' task context, not this one
-        self._streams[sid] = {"gen": gen, "model_id": model_id,
-                              "last_pull": _time.time()}
+        # streams count against max_ongoing_requests for their whole
+        # lifetime (slot released in _drop_stream) — the actor-level
+        # concurrency cap no longer enforces this since it carries probe
+        # headroom
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self._max_ongoing)
         self._ongoing += 1
-        return sid
+        try:
+            await self._sem.acquire()
+        except BaseException:
+            self._ongoing -= 1
+            raise
+        try:
+            model_id = kwargs.pop("__multiplexed_model_id", "")
+            if model_id:
+                from ray_tpu.serve.multiplex import _set_current_model_id
+
+                _set_current_model_id(model_id)
+            target = (self._callable if self._is_function
+                      else getattr(self._callable, method or "__call__"))
+            gen = target(*args, **kwargs)
+            if inspect.iscoroutine(gen):
+                gen = await gen
+            sid = uuid.uuid4().hex
+            if not hasattr(self, "_streams"):
+                self._streams = {}
+            # model_id stored with the stream: the generator body executes
+            # in next_stream_items' task context, not this one
+            self._streams[sid] = {"gen": gen, "model_id": model_id,
+                                  "last_pull": _time.time()}
+            return sid
+        except BaseException:
+            self._sem.release()
+            self._ongoing -= 1
+            raise
+
+    def _release_slot(self):
+        if self._sem is not None:
+            self._sem.release()
 
     async def cancel_stream(self, stream_id: str):
         """Client-side abandonment (StreamingResponse.close/__del__)."""
@@ -132,6 +153,7 @@ class Replica:
     def _drop_stream(self, stream_id: str):
         rec = getattr(self, "_streams", {}).pop(stream_id, None)
         if rec is not None:
+            self._release_slot()
             self._ongoing -= 1
             self._handled += 1
 

@@ -1,0 +1,85 @@
+"""On-demand profiling (reference: dashboard/modules/reporter/
+profile_manager.py py-spy integration): sample a busy worker's stacks
+through the dashboard HTTP API, flamegraph-folded output."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_profile_busy_worker_via_dashboard(cluster):
+    from ray_tpu import api
+    from ray_tpu.dashboard import start_dashboard
+
+    _, port = start_dashboard(api._local_node.gcs_address)
+
+    @ray_tpu.remote
+    class Burner:
+        def __init__(self):
+            self.stop = False
+
+        def spin_hard_loop(self, seconds):
+            t0 = time.time()
+            x = 0
+            while time.time() - t0 < seconds:
+                x += sum(i * i for i in range(200))
+            return x
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    b = Burner.remote()
+    pid = ray_tpu.get(b.pid.remote())
+    busy_ref = b.spin_hard_loop.remote(8.0)
+
+    time.sleep(0.5)  # let the burn start
+    url = f"http://127.0.0.1:{port}/api/profile?pid={pid}&duration=2&hz=200"
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        out = json.loads(resp.read())
+    assert out.get("samples", 0) > 50, out
+    assert out["pid"] == pid
+    folded = out["folded"]
+    # flamegraph-compatible: "thread;frame;frame N" lines, and the busy
+    # method dominates
+    assert "spin_hard_loop" in folded, folded[:2000]
+    top = folded.splitlines()[0]
+    assert top.rsplit(" ", 1)[1].isdigit()
+    ray_tpu.get(busy_ref)
+
+    # unknown pid -> 404
+    bad = f"http://127.0.0.1:{port}/api/profile?pid=999999&duration=0.2"
+    try:
+        urllib.request.urlopen(bad, timeout=30)
+        raise AssertionError("expected HTTP error")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_node_stats_in_metrics(cluster):
+    """Per-node psutil stats ride the raylet's Prometheus endpoint
+    (reference: reporter_agent.py:314)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    worker = get_global_worker()
+    nodes = worker.gcs.get_all_node_info()
+    mport = nodes[0].get("metrics_port")
+    assert mport, nodes[0]
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{mport}/metrics", timeout=30
+    ) as resp:
+        text = resp.read().decode()
+    assert "ray_tpu_node_cpu_percent" in text
+    assert "ray_tpu_node_mem_total_bytes" in text
