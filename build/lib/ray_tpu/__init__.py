@@ -1,0 +1,71 @@
+"""ray_tpu — a TPU-native distributed AI runtime with the capabilities of Ray.
+
+Core runtime: tasks, actors, a shared-memory object store, ownership-based
+distributed refcounting, resource-aware two-level scheduling, placement
+groups, fault tolerance — plus ML libraries (train/tune/data/serve/rllib)
+whose device plane is jax/XLA/pallas over TPU ICI instead of torch/NCCL.
+
+Attribute access is lazy (PEP 562) so control-plane processes (gcs_server,
+raylet) that import only their own submodules don't pay for the full API.
+"""
+
+from ray_tpu._version import version as __version__  # noqa: F401
+
+_API = {
+    "available_resources", "cancel", "cluster_resources", "get", "init",
+    "is_initialized", "kill", "nodes", "put", "remote", "shutdown",
+    "timeline", "wait",
+}
+
+__all__ = sorted(
+    _API
+    | {
+        "__version__", "ObjectRef", "ActorClass", "ActorHandle", "get_actor",
+        "RemoteFunction", "get_runtime_context", "exceptions", "method",
+    }
+)
+
+
+def __getattr__(name):
+    if name in _API:
+        import ray_tpu.api as _api
+
+        return getattr(_api, name)
+    if name == "ObjectRef":
+        from ray_tpu._private.object_ref import ObjectRef
+
+        return ObjectRef
+    if name in ("ActorClass", "ActorHandle", "get_actor"):
+        import ray_tpu.actor as _actor
+
+        return getattr(_actor, name)
+    if name == "RemoteFunction":
+        from ray_tpu.remote_function import RemoteFunction
+
+        return RemoteFunction
+    if name == "get_runtime_context":
+        from ray_tpu.runtime_context import get_runtime_context
+
+        return get_runtime_context
+    if name == "exceptions":
+        import ray_tpu.exceptions as _exc
+
+        return _exc
+    if name == "method":
+        from ray_tpu.actor import method
+
+        return method
+    if name == "util":
+        import ray_tpu.util as _util
+
+        return _util
+    if name == "cluster_utils":
+        import ray_tpu.cluster_utils as _cu
+
+        return _cu
+    if name in ("train", "tune", "data", "serve", "rllib", "workflow",
+                "dag", "autoscaler", "job_submission"):
+        import importlib
+
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute '{name}'")

@@ -1,0 +1,133 @@
+"""Central config-flag system.
+
+Mirrors the reference's single-source-of-truth flag table
+(reference: src/ray/common/ray_config_def.h — ~900 RAY_CONFIG(type, name, default)
+entries, overridable via RAY_<name> env vars). Here every flag is declared once in
+_FLAGS and overridable via ``RTPU_<name>`` environment variables or an explicit
+``system_config`` dict passed at init time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {
+    # --- object store / serialization -------------------------------------
+    # Results at or below this size are returned inline in the task reply and live
+    # in the owner's in-process memory store; larger ones go to plasma.
+    "max_direct_call_object_size": 100 * 1024,
+    # Shared-memory object store capacity per node (bytes).
+    "object_store_memory": 2 * 1024**3,
+    # Chunk size for node-to-node object transfer.
+    "object_manager_chunk_size": 4 * 1024**2,
+    # --- object spilling / memory pressure ---------------------------------
+    # Watermark: spill pinned primaries to disk when plasma use crosses this
+    # fraction (reference: object_spilling_threshold).
+    "object_spilling_threshold": 0.8,
+    "object_spilling_check_period_ms": 500,
+    # Node memory fraction beyond which the raylet kills a worker to avert
+    # host OOM (reference: memory_monitor.h memory_usage_threshold). Set
+    # memory_monitor_refresh_ms to 0 to disable.
+    "memory_usage_threshold": 0.95,
+    "memory_monitor_refresh_ms": 250,
+    # --- scheduling --------------------------------------------------------
+    # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
+    "scheduler_spread_threshold": 0.5,
+    "worker_lease_timeout_ms": 30_000,
+    # Max tasks shipped per PushTasks RPC when the submit queue is deep
+    # (adaptive: batch stays 1 unless queue >> leased workers).
+    "task_push_max_batch": 16,
+    # Cap on concurrent RequestWorkerLease RPCs per scheduling key.
+    "max_lease_requests_in_flight": 16,
+    # Actor-task pushes pipeline up to this many batch RPCs per actor
+    # (reference: actor_task_submitter.h pushes without waiting for prior
+    # replies; the receiver's seq_no reorder buffer restores order).
+    "actor_push_max_inflight": 4,
+    # Thread cap of the persistent pool serving batched normal-task
+    # execution (tasks in one batch may synchronize with each other, so
+    # each needs its own thread while running).
+    "batch_exec_max_threads": 256,
+    # How long a PG-bound task waits for its group's 2PC to finish before failing.
+    "placement_group_ready_timeout_s": 60.0,
+    # Max idle workers kept alive per node (soft cap, like num_cpus in reference).
+    "idle_worker_keep_alive_s": 120.0,
+    "worker_startup_timeout_s": 60.0,
+    # --- fault tolerance ---------------------------------------------------
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    "health_check_period_ms": 1000,
+    "health_check_failure_threshold": 5,
+    "max_lineage_bytes": 64 * 1024**2,
+    # --- GCS fault tolerance ----------------------------------------------
+    # Persist GCS tables to <session_dir>/gcs.log so a restarted GCS resumes
+    # the cluster (reference: redis_store_client.h).
+    "gcs_persistence": True,
+    # fsync every log append (durability vs throughput).
+    "gcs_log_fsync": False,
+    # Compact the append log into a snapshot once it exceeds this size.
+    "gcs_log_compact_bytes": 64 * 1024**2,
+    # How long clients retry connecting to a dead GCS before giving up.
+    "gcs_reconnect_timeout_s": 30.0,
+    # --- timeouts ----------------------------------------------------------
+    "gcs_rpc_timeout_s": 30.0,
+    "get_timeout_warning_s": 10.0,
+    "resource_report_period_ms": 250,
+    # --- pubsub ------------------------------------------------------------
+    "pubsub_poll_timeout_s": 30.0,
+    "pubsub_max_batch": 1000,
+    # --- task events / observability --------------------------------------
+    "task_events_flush_period_ms": 1000,
+    "task_events_max_buffer": 10_000,
+    "metrics_report_period_ms": 2000,
+    # --- TPU ---------------------------------------------------------------
+    # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
+    "num_tpu_chips": -1,
+    "tpu_pod_type": "",
+}
+
+
+class _Config:
+    """Attribute access over the flag table with env-var overrides.
+
+    Precedence: explicit ``apply_system_config`` > ``RTPU_<name>`` env var > default.
+    """
+
+    def __init__(self):
+        self._overrides: Dict[str, Any] = {}
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._overrides:
+            return self._overrides[name]
+        if name not in _FLAGS:
+            raise AttributeError(f"Unknown config flag: {name}")
+        default = _FLAGS[name]
+        env = os.environ.get(f"RTPU_{name}")
+        if env is None:
+            return default
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes")
+        if isinstance(default, int):
+            return int(env)
+        if isinstance(default, float):
+            return float(env)
+        return env
+
+    def apply_system_config(self, cfg: Dict[str, Any] | str | None):
+        if cfg is None:
+            return
+        if isinstance(cfg, str):
+            cfg = json.loads(cfg)
+        for k, v in cfg.items():
+            if k not in _FLAGS:
+                raise ValueError(f"Unknown config flag: {k}")
+            self._overrides[k] = v
+
+    def dump(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _FLAGS}
+
+
+RTPU_CONFIG = _Config()

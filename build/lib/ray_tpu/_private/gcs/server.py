@@ -1,0 +1,1254 @@
+"""GCS — the cluster control plane (one per cluster).
+
+TPU-native counterpart of the reference's gcs_server
+(reference: src/ray/gcs/gcs_server/gcs_server.h:78): node membership and
+health, the actor directory with restart fault-tolerance, placement groups
+with two-phase reserve/commit, jobs, a namespaced KV (which also backs the
+function table), long-poll batched pubsub (reference: src/ray/pubsub/), task
+events, and the cluster resource view that feeds scheduling/spillback and the
+autoscaler. Everything runs on one asyncio loop, like the reference's single
+asio io_context.
+
+State is in-memory, persisted through a msgpack append log
+(``persistence.GcsLog``) covering the KV/job/actor/named-actor/placement-
+group/node tables. On restart the log replays and the cluster resumes:
+raylets re-register on their next heartbeat, pubsub subscribers re-subscribe
+when they observe a new server epoch (reference uses Redis for this —
+src/ray/gcs/store_client/redis_store_client.h).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.gcs.persistence import GcsLog
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.rpc import ClientPool, RpcServer
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+# Actor lifecycle states (reference: protobuf gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class KVStore:
+    def __init__(self):
+        self._data: Dict[str, Dict[bytes, bytes]] = {}
+
+    def _ns(self, ns: str) -> Dict[bytes, bytes]:
+        return self._data.setdefault(ns or "", {})
+
+    def put(self, ns, key, value, overwrite=True) -> bool:
+        table = self._ns(ns)
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    def get(self, ns, key):
+        return self._ns(ns).get(key)
+
+    def delete(self, ns, key) -> bool:
+        return self._ns(ns).pop(key, None) is not None
+
+    def keys(self, ns, prefix=b""):
+        return [k for k in self._ns(ns) if k.startswith(prefix)]
+
+    def exists(self, ns, key) -> bool:
+        return key in self._ns(ns)
+
+
+class PubSub:
+    """Long-poll batched pubsub, one queue per subscriber.
+
+    The reference replaced per-key long-polling with batched channel polling
+    (reference: src/ray/pubsub/README.md); same design here: subscribers poll
+    and receive every buffered (channel, message) batch at once.
+    """
+
+    def __init__(self):
+        self._subs: Dict[bytes, Dict[str, Any]] = {}
+
+    def subscribe(self, sub_id: bytes, channel: str):
+        sub = self._subs.setdefault(
+            sub_id, {"channels": set(), "queue": [], "event": asyncio.Event()}
+        )
+        sub["channels"].add(channel)
+
+    def unsubscribe(self, sub_id: bytes, channel: Optional[str]):
+        sub = self._subs.get(sub_id)
+        if not sub:
+            return
+        if channel is None:
+            del self._subs[sub_id]
+        else:
+            sub["channels"].discard(channel)
+
+    def publish(self, channel: str, message):
+        for sub in self._subs.values():
+            for ch in sub["channels"]:
+                if channel == ch or (ch.endswith("*") and channel.startswith(ch[:-1])):
+                    q = sub["queue"]
+                    q.append([channel, message])
+                    if len(q) > RTPU_CONFIG.pubsub_max_batch:
+                        del q[: len(q) - RTPU_CONFIG.pubsub_max_batch]
+                    sub["event"].set()
+                    break
+
+    async def poll(self, sub_id: bytes, timeout: float):
+        sub = self._subs.setdefault(
+            sub_id, {"channels": set(), "queue": [], "event": asyncio.Event()}
+        )
+        if not sub["queue"]:
+            sub["event"].clear()
+            try:
+                await asyncio.wait_for(sub["event"].wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+        batch = sub["queue"]
+        sub["queue"] = []
+        return batch
+
+
+class GcsServer:
+    def __init__(self, host="127.0.0.1", session_dir: str = "", persist_path: str = ""):
+        self.host = host
+        self.session_dir = session_dir
+        self.server = RpcServer(host)
+        from ray_tpu._private import schema as _schema
+
+        self.server.set_validator(_schema.make_validator(_schema.GCS_SCHEMAS))
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        self.pool = ClientPool()  # clients to raylets / workers
+        self.start_time = time.time()
+        # A fresh epoch per server process: clients detect a restart by the
+        # epoch changing and re-subscribe their pubsub channels.
+        self.epoch = uuid.uuid4().hex
+        if not persist_path and session_dir and RTPU_CONFIG.gcs_persistence:
+            persist_path = os.path.join(session_dir, "gcs.log")
+        self.log: Optional[GcsLog] = (
+            GcsLog(persist_path, fsync=RTPU_CONFIG.gcs_log_fsync)
+            if persist_path
+            else None
+        )
+        self._compacting = False
+        self._compact_buffer: List[Tuple[str, Any]] = []
+
+        # node_id(bytes) -> info dict
+        self.nodes: Dict[bytes, dict] = {}
+        self.node_last_beat: Dict[bytes, float] = {}
+        # actor_id(bytes) -> record
+        self.actors: Dict[bytes, dict] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
+        self.pending_actor_queue: List[bytes] = []
+        # pg_id(bytes) -> record
+        self.placement_groups: Dict[bytes, dict] = {}
+        self.pending_pg_queue: List[bytes] = []
+        self.jobs: Dict[bytes, dict] = {}
+        self.task_events: List[dict] = []
+        self._worker_failures: List[dict] = []
+        # (name, sorted-label-items) -> aggregated user-metric record
+        self.user_metrics: Dict[Tuple[str, tuple], dict] = {}
+        self.metrics_port = 0
+        self._bg_tasks = []
+
+    # ------------------------------------------------------------------ util
+
+    def _raylet_client(self, node_id: bytes):
+        info = self.nodes[node_id]
+        return self.pool.get(info["ip"], info["raylet_port"])
+
+    def alive_nodes(self) -> List[bytes]:
+        return [nid for nid, n in self.nodes.items() if n["state"] == "ALIVE"]
+
+    # ---------------------------------------------------------- persistence
+
+    def _persist(self, kind: str, data):
+        if self.log is None:
+            return
+        if self._compacting:
+            # A snapshot write is in flight off-loop; appends to the old file
+            # would be clobbered by the rename. Buffer and flush after.
+            self._compact_buffer.append((kind, data))
+            return
+        try:
+            self.log.append(kind, data)
+        except Exception:
+            logger.exception("gcs log append failed")
+
+    def _persist_actor(self, rec: dict):
+        self._persist("actor", rec)
+
+    def _persist_pg(self, pg: dict):
+        self._persist("pg", {k: v for k, v in pg.items() if k != "ready_event"})
+
+    def _restore(self):
+        """Replay the append log into the in-memory tables, then compact.
+
+        A malformed record (version skew, partial corruption past the frame
+        check) is skipped, never fatal: a GCS that cannot start is strictly
+        worse than one missing a record, and the node monitor would respawn
+        a crashing GCS forever.
+        """
+        if self.log is None:
+            return
+        n = 0
+        try:
+            replay = list(self.log.replay())
+        except Exception:
+            logger.exception("gcs log unreadable; starting empty")
+            return
+        for kind, data in replay:
+            try:
+                n += 1
+                if kind == "kv":
+                    ns, key, value = data
+                    if value is None:
+                        self.kv.delete(ns, key)
+                    else:
+                        self.kv.put(ns, key, value)
+                elif kind == "job":
+                    self.jobs[data["job_id"]] = data
+                elif kind == "actor":
+                    self.actors[data["actor_id"]] = data
+                elif kind == "named":
+                    ns, name, actor_id = data
+                    if actor_id is None:
+                        self.named_actors.pop((ns, name), None)
+                    else:
+                        self.named_actors[(ns, name)] = actor_id
+                elif kind == "pg":
+                    data["ready_event"] = None
+                    self.placement_groups[data["pg_id"]] = data
+                elif kind == "node":
+                    self.nodes[data["node_id"]] = data
+            except Exception:
+                logger.exception("skipping malformed gcs log record kind=%r", kind)
+        if n == 0:
+            return
+        now = time.time()
+        for node_id, info in self.nodes.items():
+            # Give restored nodes a full grace window to heartbeat back in.
+            self.node_last_beat[node_id] = now
+        for actor_id, rec in self.actors.items():
+            if rec["state"] in (PENDING_CREATION, RESTARTING):
+                self.pending_actor_queue.append(actor_id)
+        for pg_id, pg in self.placement_groups.items():
+            if pg["state"] in ("PENDING", "RESCHEDULING"):
+                self.pending_pg_queue.append(pg_id)
+        logger.info(
+            "GCS restored from %s: %d records, %d nodes, %d actors, %d pgs, %d jobs",
+            self.log.path, n, len(self.nodes), len(self.actors),
+            len(self.placement_groups), len(self.jobs),
+        )
+        self._compact()
+
+    def _snapshot_records(self) -> List[Tuple[str, Any]]:
+        records: List[Tuple[str, Any]] = []
+        for ns, table in self.kv._data.items():
+            for key, value in table.items():
+                records.append(("kv", [ns, key, value]))
+        for job in self.jobs.values():
+            records.append(("job", job))
+        for rec in self.actors.values():
+            records.append(("actor", rec))
+        for (ns, name), actor_id in self.named_actors.items():
+            records.append(("named", [ns, name, actor_id]))
+        for pg in self.placement_groups.values():
+            records.append(
+                ("pg", {k: v for k, v in pg.items() if k != "ready_event"})
+            )
+        for info in self.nodes.values():
+            records.append(("node", info))
+        return records
+
+    def _compact(self):
+        if self.log is None:
+            return
+        try:
+            self.log.compact(self._snapshot_records())
+        except Exception:
+            logger.exception("gcs log compaction failed")
+
+    async def _compaction_loop(self):
+        """Compact off-loop: the snapshot is captured synchronously (cheap,
+        point-in-time consistent) but the serialize+fsync runs in a thread so
+        a large state dump cannot stall heartbeat handling past the health
+        threshold and wrongly kill every node."""
+        limit = RTPU_CONFIG.gcs_log_compact_bytes
+        while True:
+            await asyncio.sleep(5.0)
+            if self.log is None or self.log.size() <= limit or self._compacting:
+                continue
+            # Pack on the loop (consistent point-in-time view of the live
+            # table dicts); only the write+fsync goes to the thread.
+            blob = GcsLog.pack(self._snapshot_records())
+            self._compacting = True
+            self._compact_buffer = []
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.log.compact_packed, blob
+                )
+            except Exception:
+                logger.exception("gcs log compaction failed")
+            finally:
+                self._compacting = False
+                buffered, self._compact_buffer = self._compact_buffer, []
+                for kind, data in buffered:
+                    self._persist(kind, data)
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self, port: int = 0) -> int:
+        self._restore()
+        self.server.register_all(self)
+        port = await self.server.start(port)
+        try:
+            from ray_tpu._private.metrics import start_metrics_http_server
+
+            self.metrics_server, self.metrics_port = await start_metrics_http_server(
+                self.host, self._collect_metrics
+            )
+        except Exception:
+            logger.exception("metrics endpoint failed to start")
+            self.metrics_port = 0
+        self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._compaction_loop()))
+        if self.pending_actor_queue:
+            asyncio.ensure_future(self._schedule_pending_actors())
+        if self.pending_pg_queue:
+            asyncio.ensure_future(self._schedule_pending_pgs())
+        logger.info("GCS listening on %s:%s", self.host, port)
+        return port
+
+    async def _health_check_loop(self):
+        period = RTPU_CONFIG.health_check_period_ms / 1000.0
+        threshold = RTPU_CONFIG.health_check_failure_threshold
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for node_id, info in list(self.nodes.items()):
+                if info["state"] != "ALIVE":
+                    continue
+                last = self.node_last_beat.get(node_id, now)
+                if now - last > period * threshold:
+                    await self._mark_node_dead(node_id, "missed heartbeats")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or info["state"] == "DEAD":
+            return
+        info["state"] = "DEAD"
+        info["end_time"] = time.time()
+        logger.warning("node %s dead: %s", node_id.hex(), reason)
+        self._persist("node", info)
+        self.pubsub.publish("node", {"node_id": node_id, "state": "DEAD"})
+        # Fail/restart actors that lived on this node.
+        for actor_id, rec in list(self.actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION):
+                await self._on_actor_worker_lost(actor_id, f"node died: {reason}")
+        # Re-schedule placement groups that had bundles there.
+        for pg_id, pg in list(self.placement_groups.items()):
+            if pg["state"] == "CREATED" and any(
+                b.get("node_id") == node_id for b in pg["bundles"]
+            ):
+                pg["state"] = "RESCHEDULING"
+                for b in pg["bundles"]:
+                    if b.get("node_id") == node_id:
+                        b["node_id"] = None
+                self._persist_pg(pg)
+                self.pending_pg_queue.append(pg_id)
+                asyncio.ensure_future(self._schedule_pending_pgs())
+
+    # ------------------------------------------------------------ node table
+
+    async def handle_RegisterNode(self, req):
+        node_id = req["node_id"]
+        self.nodes[node_id] = {
+            "node_id": node_id,
+            "ip": req["ip"],
+            "raylet_port": req["raylet_port"],
+            "object_manager_port": req.get("object_manager_port", req["raylet_port"]),
+            "plasma_name": req.get("plasma_name", ""),
+            "resources_total": dict(req.get("resources", {})),
+            "resources_available": dict(req.get("resources", {})),
+            "labels": dict(req.get("labels", {})),
+            "state": "ALIVE",
+            "start_time": time.time(),
+            "is_head": bool(req.get("is_head")),
+            "metrics_port": req.get("metrics_port", 0),
+        }
+        self.node_last_beat[node_id] = time.time()
+        self._persist("node", self.nodes[node_id])
+        self.pubsub.publish("node", {"node_id": node_id, "state": "ALIVE"})
+        # New capacity: retry pending actors/PGs.
+        asyncio.ensure_future(self._schedule_pending_actors())
+        asyncio.ensure_future(self._schedule_pending_pgs())
+        return {"ok": True}
+
+    async def handle_UnregisterNode(self, req):
+        await self._mark_node_dead(req["node_id"], "unregistered")
+        return {"ok": True}
+
+    def _autoscaler_active_now(self) -> bool:
+        """True while an autoscaler heartbeat (timestamped KV) is fresh — a
+        crashed autoscaler must not leave raylets queueing infeasible work
+        forever."""
+        v = self.kv.get("", b"__autoscaler_active__")
+        if not v:
+            return False
+        try:
+            return time.time() - float(v) < 30.0
+        except (TypeError, ValueError):
+            return True  # legacy non-timestamped value
+
+    async def handle_GetAutoscalerActive(self, req):
+        return {"active": self._autoscaler_active_now()}
+
+    async def handle_Heartbeat(self, req):
+        node_id = req["node_id"]
+        self.node_last_beat[node_id] = time.time()
+        # "known" lets a raylet detect a GCS that restarted without its
+        # registration (e.g. persistence disabled) and re-register.
+        info = self.nodes.get(node_id)
+        return {
+            "known": info is not None and info["state"] == "ALIVE",
+            "autoscaler_active": self._autoscaler_active_now(),
+        }
+
+    async def handle_ReportResources(self, req):
+        node = self.nodes.get(req["node_id"])
+        if node is None:
+            return
+        node["resources_available"] = req["available"]
+        node["resources_total"] = req["total"]
+        node["pending_demands"] = req.get("pending_demands", [])
+        node["num_leases"] = req.get("num_leases", 0)
+        node["num_workers"] = req.get("num_workers", 0)
+        self.node_last_beat[req["node_id"]] = time.time()
+        # Push the delta to every raylet's cluster view (the RaySyncer
+        # broadcast plane, reference: common/ray_syncer/ray_syncer.h:88 —
+        # here a pubsub channel drained by batched long-polls).
+        self.pubsub.publish("resources", {
+            "node_id": req["node_id"],
+            "available": req["available"],
+            "total": req["total"],
+            "num_leases": node["num_leases"],
+            "num_workers": node["num_workers"],
+        })
+        if self.pending_actor_queue:
+            asyncio.ensure_future(self._schedule_pending_actors())
+        if self.pending_pg_queue:
+            asyncio.ensure_future(self._schedule_pending_pgs())
+
+    async def handle_GetAllNodeInfo(self, req):
+        return {"nodes": list(self.nodes.values())}
+
+    async def handle_GetClusterResources(self, req):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for nid in self.alive_nodes():
+            n = self.nodes[nid]
+            for k, v in n["resources_total"].items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n["resources_available"].items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def handle_GetInternalConfig(self, req):
+        return {"config": RTPU_CONFIG.dump(), "session_dir": self.session_dir}
+
+    async def handle_GetClusterLoad(self, req):
+        """Autoscaler input: everything waiting for resources right now
+        (reference: GcsAutoscalerStateManager::HandleGetClusterResourceState,
+        gcs_autoscaler_state_manager.h:30 — pending task shapes, pending
+        actors, unplaced placement-group bundles, per-node utilization)."""
+        pending_tasks: List[dict] = []
+        for nid in self.alive_nodes():
+            pending_tasks.extend(self.nodes[nid].get("pending_demands", []))
+        pending_actors = []
+        for actor_id in self.pending_actor_queue:
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec["state"] in (PENDING_CREATION, RESTARTING):
+                pending_actors.append(dict(rec["creation_spec"].get("resources", {})))
+        pending_pg_bundles = []
+        for pg_id in self.pending_pg_queue:
+            pg = self.placement_groups.get(pg_id)
+            if pg is not None and pg["state"] in ("PENDING", "RESCHEDULING"):
+                for b in pg["bundles"]:
+                    if b.get("node_id") is None:
+                        pending_pg_bundles.append(
+                            {"resources": dict(b["resources"]), "strategy": pg["strategy"]}
+                        )
+        nodes = [
+            {
+                "node_id": nid,
+                "resources_total": self.nodes[nid]["resources_total"],
+                "resources_available": self.nodes[nid]["resources_available"],
+                "num_leases": self.nodes[nid].get("num_leases", 0),
+                "num_workers": self.nodes[nid].get("num_workers", 0),
+                "labels": self.nodes[nid].get("labels", {}),
+                "is_head": self.nodes[nid].get("is_head", False),
+            }
+            for nid in self.alive_nodes()
+        ]
+        return {
+            "pending_tasks": pending_tasks,
+            "pending_actors": pending_actors,
+            "pending_pg_bundles": pending_pg_bundles,
+            "nodes": nodes,
+        }
+
+    # --------------------------------------------------------------------- kv
+
+    async def handle_KVPut(self, req):
+        added = self.kv.put(req["ns"], req["key"], req["value"], req.get("overwrite", True))
+        if added:
+            self._persist("kv", [req["ns"], req["key"], req["value"]])
+        return {"added": added}
+
+    async def handle_KVGet(self, req):
+        return {"value": self.kv.get(req["ns"], req["key"])}
+
+    async def handle_KVDel(self, req):
+        deleted = self.kv.delete(req["ns"], req["key"])
+        if deleted:
+            self._persist("kv", [req["ns"], req["key"], None])
+        return {"deleted": deleted}
+
+    async def handle_KVKeys(self, req):
+        return {"keys": self.kv.keys(req["ns"], req.get("prefix", b""))}
+
+    async def handle_KVExists(self, req):
+        return {"exists": self.kv.exists(req["ns"], req["key"])}
+
+    # ------------------------------------------------------------------ pubsub
+
+    async def handle_Subscribe(self, req):
+        self.pubsub.subscribe(req["sub_id"], req["channel"])
+        # Epoch lets the subscriber baseline restart detection atomically
+        # with the subscription (a restart between Subscribe and the first
+        # poll would otherwise go unnoticed forever).
+        return {"ok": True, "epoch": self.epoch}
+
+    async def handle_Unsubscribe(self, req):
+        self.pubsub.unsubscribe(req["sub_id"], req.get("channel"))
+        return {"ok": True}
+
+    async def handle_PubsubPoll(self, req):
+        timeout = min(req.get("timeout", 30.0), RTPU_CONFIG.pubsub_poll_timeout_s)
+        batch = await self.pubsub.poll(req["sub_id"], timeout)
+        # Epoch lets pollers detect a GCS restart (subscriber state is
+        # process-local) and re-subscribe their channels.
+        return {"batch": batch, "epoch": self.epoch}
+
+    async def handle_Publish(self, req):
+        self.pubsub.publish(req["channel"], req["message"])
+        return {"ok": True}
+
+    # -------------------------------------------------------------------- jobs
+
+    async def handle_AddJob(self, req):
+        self.jobs[req["job_id"]] = {
+            "job_id": req["job_id"],
+            "driver_addr": req.get("driver_addr"),
+            "start_time": time.time(),
+            "end_time": None,
+            "state": "RUNNING",
+            "entrypoint": req.get("entrypoint", ""),
+            "metadata": req.get("metadata", {}),
+            "driver_sys_path": req.get("driver_sys_path", []),
+        }
+        self._persist("job", self.jobs[req["job_id"]])
+        self.pubsub.publish("job", {"job_id": req["job_id"], "state": "RUNNING"})
+        return {"ok": True}
+
+    async def handle_GetJob(self, req):
+        job = self.jobs.get(req["job_id"])
+        return {"found": job is not None, "job": job or {}}
+
+    async def handle_MarkJobFinished(self, req):
+        job = self.jobs.get(req["job_id"])
+        if job:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+            self._persist("job", job)
+        self.pubsub.publish("job", {"job_id": req["job_id"], "state": "FINISHED"})
+        # Tell raylets to reap this job's workers.
+        for nid in self.alive_nodes():
+            try:
+                client = await self._raylet_client(nid)
+                await client.notify("JobFinished", {"job_id": req["job_id"]})
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def handle_GetAllJobInfo(self, req):
+        return {"jobs": list(self.jobs.values())}
+
+    # ------------------------------------------------------------------ actors
+
+    async def handle_RegisterActor(self, req):
+        """Register + asynchronously schedule an actor creation.
+
+        req: {actor_id, creation_spec(task spec dict), name, ray_namespace,
+              max_restarts, detached}
+        """
+        actor_id = req["actor_id"]
+        name = req.get("name") or ""
+        ns = req.get("namespace") or ""
+        if name:
+            if (ns, name) in self.named_actors:
+                existing = self.named_actors[(ns, name)]
+                # existing == actor_id: a client retry of our own
+                # registration after a GCS failover — idempotent, not a
+                # collision.
+                if existing != actor_id and self.actors.get(existing, {}).get("state") != DEAD:
+                    raise ValueError(f"actor name '{name}' already taken")
+            self.named_actors[(ns, name)] = actor_id
+            self._persist("named", [ns, name, actor_id])
+        self.actors[actor_id] = {
+            "actor_id": actor_id,
+            "state": PENDING_CREATION,
+            "creation_spec": req["creation_spec"],
+            "name": name,
+            "namespace": ns,
+            "max_restarts": req.get("max_restarts", 0),
+            "num_restarts": 0,
+            "detached": req.get("detached", False),
+            "owner_worker_id": req["creation_spec"].get("owner_worker_id"),
+            "node_id": None,
+            "worker_id": None,
+            "addr": None,
+            "job_id": req["creation_spec"]["job_id"],
+            "death_cause": "",
+            "start_time": time.time(),
+        }
+        self._persist_actor(self.actors[actor_id])
+        self.pending_actor_queue.append(actor_id)
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return {"ok": True}
+
+    def _pick_node(self, resources: Dict[str, float], strategy: dict) -> Optional[bytes]:
+        """Hybrid placement for actors/PG bundles at the GCS level.
+        node_label strategies filter candidates to hard-label matches and
+        prefer soft-label matches (reference:
+        raylet/scheduling/policy/node_label_scheduling_policy.cc)."""
+        is_label = strategy.get("type") == "node_label"
+        hard = (strategy.get("hard") or {}) if is_label else {}
+        soft = (strategy.get("soft") or {}) if is_label else {}
+        candidates = []
+        for nid in self.alive_nodes():
+            n = self.nodes[nid]
+            if strategy.get("type") == "node_affinity":
+                if nid != strategy["node_id"]:
+                    continue
+            labels = n.get("labels", {})
+            if is_label and any(labels.get(k) != v for k, v in hard.items()):
+                continue
+            avail = n["resources_available"]
+            total = n["resources_total"]
+            if all(avail.get(k, 0) >= v for k, v in resources.items()) and all(
+                total.get(k, 0) >= v for k, v in resources.items()
+            ):
+                used = sum(
+                    1 - avail.get(k, 0) / total[k] for k in total if total[k] > 0
+                )
+                soft_ok = bool(soft) and all(
+                    labels.get(k) == v for k, v in soft.items()
+                )
+                candidates.append((used, nid, soft_ok))
+        if soft and any(c[2] for c in candidates):
+            # soft-label matches exist: restrict to them (soft preference
+            # outranks the load score but never makes placement infeasible)
+            candidates = [c for c in candidates if c[2]]
+        candidates = [(used, nid) for used, nid, _ in candidates]
+        if not candidates:
+            if strategy.get("type") == "node_affinity" and strategy.get("soft"):
+                return self._pick_node(resources, {})
+            return None
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        if strategy.get("type") == "spread":
+            return candidates[0][1]  # least utilized
+        # default: pack — most utilized feasible node below threshold, else least
+        packed = [c for c in candidates if c[0] <= RTPU_CONFIG.scheduler_spread_threshold]
+        if packed:
+            return packed[-1][1]
+        return candidates[0][1]
+
+    async def _schedule_pending_actors(self):
+        queue, self.pending_actor_queue = self.pending_actor_queue, []
+        for actor_id in queue:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
+                continue
+            ok = await self._try_create_actor(actor_id, rec)
+            if not ok and self.actors.get(actor_id, {}).get("state") in (
+                PENDING_CREATION,
+                RESTARTING,
+            ):
+                self.pending_actor_queue.append(actor_id)
+
+    async def _try_create_actor(self, actor_id: bytes, rec: dict) -> bool:
+        spec = rec["creation_spec"]
+        strategy = spec.get("strategy", {})
+        if strategy.get("type") == "placement_group":
+            pg = self.placement_groups.get(strategy["pg_id"])
+            if pg is None or pg["state"] != "CREATED":
+                return False
+            bundle = pg["bundles"][strategy.get("bundle_index") or 0]
+            node_id = bundle["node_id"]
+        else:
+            node_id = self._pick_node(spec["resources"], strategy)
+        if node_id is None:
+            return False
+        try:
+            raylet = await self._raylet_client(node_id)
+            reply = await raylet.call(
+                "LeaseWorkerForActor",
+                {
+                    "actor_id": actor_id,
+                    "job_id": spec["job_id"],
+                    "resources": spec["resources"],
+                    "strategy": strategy,
+                    "runtime_env": spec.get("runtime_env", {}),
+                },
+                timeout=RTPU_CONFIG.worker_startup_timeout_s,
+            )
+        except Exception as e:
+            logger.warning("actor lease on %s failed: %s", node_id.hex(), e)
+            return False
+        if not reply.get("granted"):
+            if reply.get("error"):
+                # Deterministic failure (e.g. runtime_env setup): retrying
+                # forever would hang the caller silently — kill the actor
+                # with the cause instead.
+                rec["state"] = DEAD
+                rec["death_cause"] = reply["error"]
+                self._publish_actor(actor_id, rec)
+                return True
+            return False
+        worker_addr = tuple(reply["worker_addr"])
+        worker_id = reply["worker_id"]
+        try:
+            worker = await self.pool.get(*worker_addr)
+            result = await worker.call(
+                "CreateActor", {"spec": spec, "actor_id": actor_id},
+                timeout=RTPU_CONFIG.worker_startup_timeout_s,
+            )
+        except Exception as e:
+            logger.warning("actor creation on %s failed: %s", node_id.hex(), e)
+            return False
+        if not result.get("ok"):
+            # Creation raised in __init__: actor is DEAD with the error recorded.
+            rec["state"] = DEAD
+            rec["death_cause"] = result.get("error", "creation failed")
+            self._publish_actor(actor_id, rec)
+            return True
+        rec.update(
+            state=ALIVE, node_id=node_id, worker_id=worker_id, addr=list(worker_addr)
+        )
+        self._publish_actor(actor_id, rec)
+        return True
+
+    def _publish_actor(self, actor_id: bytes, rec: dict):
+        # Every state transition flows through here: persist alongside publish.
+        self._persist_actor(rec)
+        msg = {
+            "actor_id": actor_id,
+            "state": rec["state"],
+            "addr": rec["addr"],
+            "num_restarts": rec["num_restarts"],
+            "death_cause": rec.get("death_cause", ""),
+        }
+        self.pubsub.publish("actor", msg)
+        self.pubsub.publish(f"actor:{actor_id.hex()}", msg)
+
+    async def _on_actor_worker_lost(self, actor_id: bytes, reason: str):
+        rec = self.actors.get(actor_id)
+        if rec is None or rec["state"] == DEAD:
+            return
+        if rec["num_restarts"] < rec["max_restarts"] or rec["max_restarts"] < 0:
+            rec["num_restarts"] += 1
+            rec["state"] = RESTARTING
+            rec["addr"] = None
+            self._publish_actor(actor_id, rec)
+            self.pending_actor_queue.append(actor_id)
+            asyncio.ensure_future(self._schedule_pending_actors())
+        else:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            rec["addr"] = None
+            self._publish_actor(actor_id, rec)
+
+    async def handle_ReportWorkerDeath(self, req):
+        """Raylet tells us a worker process exited; may host an actor."""
+        actor_id = req.get("actor_id")
+        # Prune the dead worker's GAUGE series: a frozen instantaneous value
+        # exported forever poisons aggregations. Counters/histograms stay —
+        # they are cumulative totals that remain true.
+        wid = req.get("worker_id")
+        if wid:
+            wid_short = wid.hex()[:12] if isinstance(wid, bytes) else str(wid)[:12]
+            for key, rec in list(self.user_metrics.items()):
+                if (
+                    rec["kind"] == "gauge"
+                    and rec["labels"].get("WorkerId") == wid_short
+                ):
+                    del self.user_metrics[key]
+        self._worker_failures.append(
+            {"worker_id": req.get("worker_id"), "node_id": req.get("node_id"),
+             "time": time.time(), "reason": req.get("reason", "")}
+        )
+        if actor_id:
+            await self._on_actor_worker_lost(actor_id, req.get("reason", "worker died"))
+        await self._reap_owned_by(req.get("worker_id"))
+        return {"ok": True}
+
+    async def _reap_owned_by(self, worker_id):
+        """Ownership fate-sharing (reference: gcs_actor_manager
+        OnWorkerDead → destroy owned non-detached actors; PG manager
+        cleans up groups whose creator died): kill actors created by the
+        dead worker and remove its placement groups."""
+        if not worker_id:
+            return
+        for aid, rec in list(self.actors.items()):
+            if (rec.get("owner_worker_id") == worker_id
+                    and not rec.get("detached")
+                    and rec["state"] != DEAD):
+                rec["max_restarts"] = rec["num_restarts"]  # no restarts
+                try:
+                    await self.handle_KillActor(
+                        {"actor_id": aid, "no_restart": True}
+                    )
+                except Exception:
+                    pass
+                rec["death_cause"] = "owner worker died"
+        for pg_id, pg in list(self.placement_groups.items()):
+            if (pg.get("owner_worker_id") == worker_id
+                    and pg["state"] != "REMOVED"):
+                try:
+                    await self.handle_RemovePlacementGroup({"pg_id": pg_id})
+                except Exception:
+                    pass
+
+    async def handle_GetActorInfo(self, req):
+        rec = self.actors.get(req["actor_id"])
+        if rec is None:
+            return {"found": False}
+        out = {k: v for k, v in rec.items() if k != "creation_spec"}
+        return {"found": True, "actor": out}
+
+    async def handle_GetActorByName(self, req):
+        actor_id = self.named_actors.get((req.get("namespace") or "", req["name"]))
+        if actor_id is None:
+            return {"found": False}
+        return await self.handle_GetActorInfo({"actor_id": actor_id})
+
+    async def handle_ListActors(self, req):
+        out = []
+        for rec in self.actors.values():
+            out.append({k: v for k, v in rec.items() if k != "creation_spec"})
+        return {"actors": out}
+
+    async def handle_KillActor(self, req):
+        actor_id = req["actor_id"]
+        rec = self.actors.get(actor_id)
+        if rec is None:
+            return {"ok": False}
+        no_restart = req.get("no_restart", True)
+        if no_restart:
+            rec["max_restarts"] = rec["num_restarts"]  # exhaust restarts
+        if rec.get("addr"):
+            try:
+                worker = await self.pool.get(*rec["addr"])
+                await worker.notify("KillActor", {"actor_id": actor_id})
+            except Exception:
+                pass
+        if no_restart:
+            rec["state"] = DEAD
+            rec["death_cause"] = "killed via kill()"
+            name = rec.get("name")
+            if name:
+                self.named_actors.pop((rec.get("namespace", ""), name), None)
+                self._persist("named", [rec.get("namespace", ""), name, None])
+            self._publish_actor(actor_id, rec)
+        return {"ok": True}
+
+    # -------------------------------------------------------- placement groups
+
+    async def handle_CreatePlacementGroup(self, req):
+        pg_id = req["pg_id"]
+        self.placement_groups[pg_id] = {
+            "pg_id": pg_id,
+            "name": req.get("name", ""),
+            "strategy": req.get("strategy", "PACK"),
+            "bundles": [
+                {"index": i, "resources": dict(b), "node_id": None}
+                for i, b in enumerate(req["bundles"])
+            ],
+            "state": "PENDING",
+            "job_id": req.get("job_id"),
+            "owner_worker_id": req.get("owner_worker_id"),
+            "ready_event": None,
+        }
+        self._persist_pg(self.placement_groups[pg_id])
+        self.pending_pg_queue.append(pg_id)
+        asyncio.ensure_future(self._schedule_pending_pgs())
+        return {"ok": True}
+
+    def _select_pg_nodes(self, pg) -> Optional[List[bytes]]:
+        """Choose a node per bundle according to the PG strategy.
+
+        Strategies per reference common.proto:939: PACK, SPREAD, STRICT_PACK,
+        STRICT_SPREAD.
+        """
+        strategy = pg["strategy"]
+        bundles = pg["bundles"]
+        nodes = {
+            nid: dict(self.nodes[nid]["resources_available"])
+            for nid in self.alive_nodes()
+        }
+
+        def fits(avail, res):
+            return all(avail.get(k, 0) >= v for k, v in res.items())
+
+        def take(avail, res):
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+
+        if strategy == "STRICT_PACK":
+            for nid, avail in sorted(nodes.items()):
+                trial = dict(avail)
+                if all(self._fits_take(trial, b["resources"]) for b in bundles):
+                    return [nid] * len(bundles)
+            return None
+
+        placement: List[Optional[bytes]] = [None] * len(bundles)
+        used_nodes: List[bytes] = []
+        # Order node preference: pack→most loaded first reuse; spread→rotate.
+        order = sorted(nodes.keys())
+        for i, b in enumerate(bundles):
+            chosen = None
+            if strategy in ("SPREAD", "STRICT_SPREAD"):
+                pref = [n for n in order if n not in used_nodes] + (
+                    [] if strategy == "STRICT_SPREAD" else [n for n in order if n in used_nodes]
+                )
+            else:  # PACK: prefer already-used nodes
+                pref = [n for n in order if n in used_nodes] + [
+                    n for n in order if n not in used_nodes
+                ]
+            for nid in pref:
+                if fits(nodes[nid], b["resources"]):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return None
+            take(nodes[chosen], b["resources"])
+            placement[i] = chosen
+            if chosen not in used_nodes:
+                used_nodes.append(chosen)
+        return placement
+
+    @staticmethod
+    def _fits_take(avail, res):
+        if all(avail.get(k, 0) >= v for k, v in res.items()):
+            for k, v in res.items():
+                avail[k] = avail.get(k, 0) - v
+            return True
+        return False
+
+    async def _schedule_pending_pgs(self):
+        queue, self.pending_pg_queue = self.pending_pg_queue, []
+        for pg_id in queue:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg["state"] in ("CREATED", "REMOVED"):
+                continue
+            try:
+                ok = await self._try_create_pg(pg_id, pg)
+            except Exception:
+                logger.exception("pg %s creation attempt failed", pg_id.hex())
+                ok = False
+            if not ok and self.placement_groups.get(pg_id, {}).get("state") in (
+                "PENDING",
+                "RESCHEDULING",
+            ):
+                self.pending_pg_queue.append(pg_id)
+
+    async def _try_create_pg(self, pg_id: bytes, pg) -> bool:
+        placement = self._select_pg_nodes(pg)
+        if placement is None:
+            return False
+        # Phase 1: prepare (reserve) on each raylet, all bundles in parallel
+        # (2PC like reference gcs_placement_group_scheduler.h).
+        async def _prepare(bundle, node_id):
+            raylet = await self._raylet_client(node_id)
+            r = await raylet.call(
+                "PrepareBundle",
+                {"pg_id": pg_id, "bundle_index": bundle["index"],
+                 "resources": bundle["resources"]},
+                timeout=10,
+            )
+            return bool(r.get("ok"))
+
+        results = await asyncio.gather(
+            *(_prepare(b, n) for b, n in zip(pg["bundles"], placement)),
+            return_exceptions=True,
+        )
+        if not all(r is True for r in results):
+            # roll back every successfully-prepared bundle
+            async def _cancel(bundle, node_id):
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    await raylet.notify(
+                        "CancelBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
+                except Exception:
+                    pass
+
+            await asyncio.gather(*(
+                _cancel(b, n)
+                for (b, n), r in zip(zip(pg["bundles"], placement), results)
+                if r is True
+            ))
+            return False
+
+        # Phase 2: commit, in parallel. A commit failure (raylet died between
+        # prepare and commit) must roll back the committed/prepared bundles
+        # and report failure — NOT raise, or the whole pending queue is lost.
+        async def _commit(bundle, node_id):
+            raylet = await self._raylet_client(node_id)
+            await raylet.call(
+                "CommitBundle",
+                {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                timeout=10,
+            )
+            bundle["node_id"] = node_id
+
+        commit_results = await asyncio.gather(
+            *(_commit(b, n) for b, n in zip(pg["bundles"], placement)),
+            return_exceptions=True,
+        )
+        if any(isinstance(r, BaseException) for r in commit_results):
+            async def _rollback(bundle, node_id):
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    # ReturnBundle releases committed state; CancelBundle
+                    # covers still-only-prepared bundles. Send both —
+                    # raylets treat unknown bundles as no-ops.
+                    await raylet.notify(
+                        "ReturnBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
+                    await raylet.notify(
+                        "CancelBundle",
+                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
+                    )
+                except Exception:
+                    pass
+
+            await asyncio.gather(*(
+                _rollback(b, n) for b, n in zip(pg["bundles"], placement)
+            ))
+            for bundle in pg["bundles"]:
+                bundle["node_id"] = None
+            return False
+        pg["state"] = "CREATED"
+        self._persist_pg(pg)
+        if pg.get("ready_event") is not None:
+            pg["ready_event"].set()
+        self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
+        # PG capacity consumed: retry pending actors that wait on it.
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return True
+
+    async def handle_GetPlacementGroup(self, req):
+        pg = self.placement_groups.get(req["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, "pg": {k: v for k, v in pg.items() if k != "ready_event"}}
+
+    async def handle_ListPlacementGroups(self, req):
+        return {
+            "pgs": [
+                {k: v for k, v in pg.items() if k != "ready_event"}
+                for pg in self.placement_groups.values()
+            ]
+        }
+
+    async def handle_WaitPlacementGroupReady(self, req):
+        pg_id = req["pg_id"]
+        deadline = time.time() + req.get("timeout", 60.0)
+        while True:
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg["state"] == "REMOVED":
+                raise ValueError("placement group removed")
+            if pg["state"] == "CREATED":
+                return {"ready": True}
+            # PENDING / RESCHEDULING: wait for the next state transition.
+            # A previous creation may have left the event set (e.g. the PG
+            # went CREATED -> node died -> RESCHEDULING); arm a fresh one.
+            if pg.get("ready_event") is None or pg["ready_event"].is_set():
+                pg["ready_event"] = asyncio.Event()
+            left = deadline - time.time()
+            if left <= 0:
+                return {"ready": False}
+            try:
+                await asyncio.wait_for(pg["ready_event"].wait(), left)
+            except asyncio.TimeoutError:
+                return {"ready": False}
+
+    async def handle_RemovePlacementGroup(self, req):
+        pg_id = req["pg_id"]
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return {"ok": True}
+        for bundle in pg["bundles"]:
+            node_id = bundle.get("node_id")
+            if node_id and node_id in self.nodes:
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    await raylet.notify(
+                        "ReturnBundle", {"pg_id": pg_id, "bundle_index": bundle["index"]}
+                    )
+                except Exception:
+                    pass
+        pg["state"] = "REMOVED"
+        self._persist_pg(pg)
+        if pg.get("ready_event") is not None:
+            pg["ready_event"].set()  # wake waiters; they observe REMOVED
+        self.pubsub.publish("pg", {"pg_id": pg_id, "state": "REMOVED"})
+        return {"ok": True}
+
+    # -------------------------------------------------------------- task events
+
+    async def handle_AddTaskEvents(self, req):
+        self.task_events.extend(req["events"])
+        overflow = len(self.task_events) - 100_000
+        if overflow > 0:
+            del self.task_events[:overflow]
+        return {"ok": True}
+
+    async def handle_GetTaskEvents(self, req):
+        job_id = req.get("job_id")
+        out = [
+            e
+            for e in self.task_events
+            if job_id is None or e.get("job_id") == job_id
+        ]
+        limit = req.get("limit", 10_000)
+        return {"events": out[-limit:]}
+
+    async def handle_GetWorkerFailures(self, req):
+        return {"failures": self._worker_failures[-req.get("limit", 1000):]}
+
+    # ------------------------------------------------------------- metrics
+
+    async def handle_ReportUserMetrics(self, req):
+        """Workers push ray_tpu.util.metrics records with their task-event
+        flush; series are keyed by (name, labels) — the reporter already
+        stamped worker/job labels so series never collide across workers."""
+        for rec in req.get("records", []):
+            key = (rec["name"], tuple(sorted(rec.get("labels", {}).items())))
+            cur = self.user_metrics.get(key)
+            if cur is None:
+                self.user_metrics[key] = cur = {
+                    "kind": rec["kind"], "name": rec["name"],
+                    "help": rec.get("help", ""), "labels": rec.get("labels", {}),
+                    "value": 0.0, "buckets": {}, "count": 0, "sum": 0.0,
+                    "boundaries": rec.get("boundaries") or [],
+                }
+            if rec["kind"] == "gauge":
+                cur["value"] = rec["value"]
+            elif rec["kind"] == "counter":
+                cur["value"] += rec["value"]
+            elif rec["kind"] == "histogram":
+                for b, c in rec.get("buckets", {}).items():
+                    cur["buckets"][b] = cur["buckets"].get(b, 0) + c
+                cur["count"] += rec.get("count", 0)
+                cur["sum"] += rec.get("sum", 0.0)
+        return {"ok": True}
+
+    def _collect_metrics(self) -> str:
+        from ray_tpu._private.metrics import render_prometheus
+
+        samples = []
+
+        def count_by_state(metric: str, rows):
+            by_state: Dict[str, int] = {}
+            for r in rows:
+                by_state[r["state"]] = by_state.get(r["state"], 0) + 1
+            for state, count in by_state.items():
+                samples.append((metric, {"state": state}, count))
+
+        count_by_state("ray_tpu_gcs_nodes", self.nodes.values())
+        count_by_state("ray_tpu_gcs_actors", self.actors.values())
+        count_by_state("ray_tpu_gcs_placement_groups", self.placement_groups.values())
+        count_by_state("ray_tpu_gcs_jobs", self.jobs.values())
+        samples.append(("ray_tpu_gcs_task_events_buffered", {}, len(self.task_events)))
+        samples.append(("ray_tpu_gcs_uptime_seconds", {}, time.time() - self.start_time))
+        # user metrics (util/metrics.py)
+        for rec in self.user_metrics.values():
+            if rec["kind"] == "histogram":
+                cumulative = 0
+                for b in rec.get("boundaries", []):
+                    cumulative += rec["buckets"].get(str(b), 0)
+                    samples.append(
+                        (f"{rec['name']}_bucket", {**rec["labels"], "le": str(b)}, cumulative)
+                    )
+                # Prometheus requires le="+Inf" == count.
+                samples.append(
+                    (f"{rec['name']}_bucket", {**rec["labels"], "le": "+Inf"}, rec["count"])
+                )
+                samples.append((f"{rec['name']}_count", rec["labels"], rec["count"]))
+                samples.append((f"{rec['name']}_sum", rec["labels"], rec["sum"]))
+            else:
+                samples.append((rec["name"], rec["labels"], rec["value"]))
+        return render_prometheus(samples)
+
+    async def handle_Ping(self, req):
+        return {
+            "ok": True,
+            "uptime": time.time() - self.start_time,
+            "metrics_port": getattr(self, "metrics_port", 0),
+        }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", default="")
+    parser.add_argument("--port-file", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    async def run():
+        server = GcsServer(args.host, args.session_dir)
+        port = await server.start(args.port)
+        if args.port_file:
+            tmp = args.port_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(port))
+            os.replace(tmp, args.port_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""In-process stack sampling for on-demand profiling.
+
+Reference: the dashboard's py-spy/memray integration
+(dashboard/modules/reporter/profile_manager.py:78/:189). The same
+capability without the binary dependency: any worker can sample its own
+threads' stacks via sys._current_frames at a fixed rate and return
+flamegraph-compatible folded lines ("a;b;c 42"). The dashboard asks the
+raylet, the raylet asks the worker (both plain RPCs), so profiling any
+process in the cluster is one HTTP call.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict
+
+
+def _frame_label(frame) -> str:
+    code = frame.f_code
+    fname = code.co_filename.rsplit("/", 1)[-1]
+    return f"{code.co_name} ({fname}:{frame.f_lineno})"
+
+
+def sample_stacks(duration_s: float = 2.0, hz: float = 100.0,
+                  include_idle: bool = False) -> Dict[str, int]:
+    """Sample all threads for duration_s; returns {folded_stack: count}.
+
+    Runs in the CALLING thread — callers dispatch it to a sampler thread
+    (the worker RPC handler does) so the sampled threads keep running.
+    """
+    duration_s = min(float(duration_s), 60.0)
+    hz = min(max(1.0, float(hz)), 500.0)
+    period = 1.0 / hz
+    me = threading.get_ident()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    counts: Counter = Counter()
+    end = time.monotonic() + duration_s
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            name = names.get(tid) or str(tid)
+            if not include_idle and (
+                name.startswith("rtpu-io")
+                or name.endswith("-watchdog")
+            ):
+                # the io loop is ~always parked in epoll; skip unless asked
+                continue
+            stack = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 128:
+                stack.append(_frame_label(f))
+                f = f.f_back
+                depth += 1
+            stack.reverse()
+            counts[f"{name};" + ";".join(stack)] += 1
+        time.sleep(period)
+        names = {t.ident: t.name for t in threading.enumerate()}
+    return dict(counts)
+
+
+def folded_text(counts: Dict[str, int]) -> str:
+    """flamegraph.pl-compatible folded output, heaviest first."""
+    return "\n".join(
+        f"{stack} {n}"
+        for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
+    )

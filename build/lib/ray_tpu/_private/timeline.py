@@ -1,0 +1,104 @@
+"""Task timeline: Chrome-trace dump of the GCS task-event log.
+
+Counterpart of ``ray timeline`` (reference: python/ray/_private/state.py:944
+chrome_tracing_dump :434 — task state transitions buffered by every core
+worker, flushed to the GCS task-event sink, rendered as Chrome's trace-event
+JSON). Open the output in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+_TERMINAL = ("FINISHED", "FAILED")
+
+
+def chrome_trace_events(events: List[dict]) -> List[dict]:
+    """Fold raw task events into Chrome 'X' (complete) + 'i' (instant) events."""
+    by_task: Dict[str, List[dict]] = {}
+    for ev in events:
+        by_task.setdefault(ev["task_id"], []).append(ev)
+    out: List[dict] = []
+    for task_id, evs in by_task.items():
+        evs.sort(key=lambda e: e["ts"])
+        running_ev = None
+        for ev in evs:
+            if ev["state"] == "SPAN":
+                # User/tracing span (ray_tpu.util.tracing) — duration baked in.
+                out.append(
+                    {
+                        "cat": "span",
+                        "name": ev.get("name") or "span",
+                        "ph": "X",
+                        "ts": ev["ts"] * 1e6,
+                        "dur": max(0.0, ev.get("dur", 0.0) * 1e6),
+                        "pid": f"node:{(ev.get('node_id') or '?')[:8]}",
+                        "tid": f"worker:{(ev.get('worker_id') or '?')[:8]}",
+                        "args": {
+                            "trace_id": ev.get("trace_id", ""),
+                            "span_id": ev.get("task_id", ""),
+                            "parent_span_id": ev.get("parent_span_id", ""),
+                            **(ev.get("attributes") or {}),
+                            "error": ev.get("error", ""),
+                        },
+                    }
+                )
+                continue
+            if ev["state"] == "RUNNING":
+                running_ev = ev
+            elif ev["state"] in _TERMINAL and running_ev is not None:
+                out.append(
+                    {
+                        "cat": "task",
+                        "name": ev.get("name") or task_id[:8],
+                        "ph": "X",
+                        "ts": running_ev["ts"] * 1e6,
+                        "dur": max(0.0, (ev["ts"] - running_ev["ts"]) * 1e6),
+                        "pid": f"node:{(ev.get('node_id') or '?')[:8]}",
+                        "tid": f"worker:{(ev.get('worker_id') or '?')[:8]}",
+                        "args": {
+                            "task_id": task_id,
+                            "job_id": ev.get("job_id", ""),
+                            "state": ev["state"],
+                            "error": ev.get("error", ""),
+                        },
+                        "cname": (
+                            "thread_state_runnable"
+                            if ev["state"] == "FINISHED"
+                            else "terrible"
+                        ),
+                    }
+                )
+                running_ev = None
+            elif ev["state"] in ("SUBMITTED", "RETRY"):
+                out.append(
+                    {
+                        "cat": "task",
+                        "name": f"{ev.get('name') or task_id[:8]}:{ev['state']}",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ev["ts"] * 1e6,
+                        "pid": f"node:{(ev.get('node_id') or '?')[:8]}",
+                        "tid": f"worker:{(ev.get('worker_id') or '?')[:8]}",
+                    }
+                )
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def timeline(filename: Optional[str] = None):
+    """Dump the cluster's task timeline; returns the event list (and writes
+    Chrome-trace JSON to ``filename`` if given)."""
+    from ray_tpu._private import worker as worker_mod
+
+    if worker_mod.global_worker is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    raw = worker_mod.global_worker.gcs.call("GetTaskEvents", {"limit": 100_000})[
+        "events"
+    ]
+    events = chrome_trace_events(raw)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,2059 @@
+"""CoreWorker: the runtime inside every worker and driver process.
+
+Counterpart of the reference's CoreWorker
+(reference: src/ray/core_worker/core_worker.h:295 — SubmitTask
+core_worker.cc:2166, Get :1552, HandlePushTask :3483) plus the
+NormalTaskSubmitter lease/push pipeline
+(reference: transport/normal_task_submitter.cc:24,:299,:547) and the
+ActorTaskSubmitter ordered queues (reference: transport/actor_task_submitter.h:73).
+
+Threading model: one background asyncio IO loop per process runs every RPC
+(client and server). Synchronous user threads (driver API, task execution
+threads) post coroutines to it and block on futures. Serialization and plasma
+reads/writes happen on user threads to keep the IO loop responsive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
+from ray_tpu._private import runtime_env as renv, serialization, task_spec as ts
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu._private.executor import Executor
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.gcs.client import GcsAioClient, GcsClient
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.memory_store import InPlasma, MemoryStore
+from ray_tpu._private.object_ref import ObjectRef, set_worker_hooks
+from ray_tpu._private.reference_counter import ReferenceCounter
+from ray_tpu._private.rpc import ClientPool, ConnectionLost, IoThread, RemoteError, RpcClient, RpcServer
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    OutOfMemoryError,
+    OwnerDiedError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+MODE_DRIVER = "driver"
+MODE_WORKER = "worker"
+
+_INLINE = "inline"
+_ERR = "err"
+
+
+class PlasmaValueBuffer:
+    """Buffer-protocol wrapper (PEP 688) tying a plasma pin to value lifetime.
+
+    Arrays deserialized zero-copy from plasma keep a reference to their buffer;
+    when the last buffer of an object dies, the shared handle releases the
+    plasma pin so the store may reclaim the memory (matches the reference
+    plasma client's buffer refcounting, reference: plasma/client.cc).
+    """
+
+    __slots__ = ("_mv", "_handle")
+
+    def __init__(self, mv: memoryview, handle: "_PinHandle"):
+        self._mv = mv
+        self._handle = handle
+        handle.count += 1
+
+    def __buffer__(self, flags):
+        return self._mv
+
+    def __len__(self):
+        return self._mv.nbytes
+
+    def __del__(self):
+        self._handle.dec()
+
+
+class _PinHandle:
+    __slots__ = ("count", "_release")
+
+    def __init__(self, release):
+        self.count = 0
+        self._release = release
+
+    def dec(self):
+        self.count -= 1
+        if self.count <= 0 and self._release is not None:
+            release, self._release = self._release, None
+            try:
+                release()
+            except Exception:
+                pass
+
+
+class TaskEventBuffer:
+    """Buffered task state transitions flushed to the GCS task-event sink
+    (reference: src/ray/core_worker/task_event_buffer.h:206)."""
+
+    def __init__(self, core):
+        self.core = core
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        # record() runs twice per task on the hot path — snapshot what never
+        # changes for this worker's lifetime
+        self._max_buffer = RTPU_CONFIG.task_events_max_buffer
+        self._worker_hex = core.worker_id.hex()
+        self._node_hex = ""
+
+    def record(self, spec: dict, state: str, error: str = ""):
+        if not self._node_hex and self.core.node_id:
+            self._node_hex = self.core.node_id.hex()
+        ev = {
+            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes) else spec["task_id"],
+            "name": spec.get("name", ""),
+            "job_id": spec.get("job_id", b"").hex() if isinstance(spec.get("job_id"), bytes) else "",
+            "state": state,
+            "ts": time.time(),
+            "node_id": self._node_hex,
+            "worker_id": self._worker_hex,
+            "error": error,
+            "actor_id": spec.get("actor_id", b"").hex() if spec.get("actor_id") else "",
+        }
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self._max_buffer:
+                del self._events[: len(self._events) // 2]
+
+    def record_span(
+        self, name: str, start: float, end: float, ctx: dict,
+        attributes: dict, error: str = "",
+    ):
+        """User/tracing span (ray_tpu.util.tracing) — rides the same buffer
+        and GCS sink as task state events; rendered by timeline()."""
+        ev = {
+            "task_id": ctx.get("span_id", ""),
+            "name": name,
+            "job_id": self.core.job_id.hex() if self.core.job_id else "",
+            "state": "SPAN",
+            "ts": start,
+            "dur": end - start,
+            "node_id": self.core.node_id.hex() if self.core.node_id else "",
+            "worker_id": self.core.worker_id.hex(),
+            "error": error,
+            "actor_id": "",
+            "trace_id": ctx.get("trace_id", ""),
+            "parent_span_id": ctx.get("parent_span_id", ""),
+            "attributes": {str(k): str(v) for k, v in attributes.items()},
+        }
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > RTPU_CONFIG.task_events_max_buffer:
+                del self._events[: len(self._events) // 2]
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            out, self._events = self._events, []
+        return out
+
+
+class _LeaseState:
+    __slots__ = ("idle", "queue", "requests_in_flight", "all_leases")
+
+    def __init__(self):
+        self.idle: deque = deque()   # lease dicts ready for reuse
+        self.queue: deque = deque()  # task specs waiting for a lease
+        self.requests_in_flight = 0
+        self.all_leases: set = set()
+
+
+class _ActorSubmitter:
+    __slots__ = (
+        "actor_id", "state", "addr", "seq", "buffer", "inflight", "watched",
+        "death_cause", "creation_refs", "push_queue", "pushing", "epoch",
+    )
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.state = "UNKNOWN"
+        self.addr: Optional[Tuple[str, int]] = None
+        self.seq = 0
+        self.buffer: deque = deque()  # specs waiting for ALIVE
+        self.push_queue: deque = deque()  # specs ready to push (actor ALIVE)
+        self.pushing = 0  # in-flight push batches awaiting their replies
+        self.epoch = 0  # bumped on restart; stale batch accounting ignores
+        self.inflight: Dict[bytes, dict] = {}  # task_id -> spec
+        self.watched = False
+        self.death_cause = ""
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,
+        gcs_address: str,
+        raylet_addr: Tuple[str, int],
+        job_id: JobID,
+        startup_token: int = -1,
+        session_dir: str = "",
+        host: str = "127.0.0.1",
+    ):
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = WorkerID.from_random()
+        self.host = host
+        self.session_dir = session_dir
+        self.io = IoThread.current()
+        self.inline_threshold = RTPU_CONFIG.max_direct_call_object_size
+        # hot-path config snapshot (each RTPU_CONFIG read is an os.environ
+        # probe, ~12 µs — these are read multiple times per task)
+        self._cfg_push_batch = RTPU_CONFIG.task_push_max_batch
+        self._cfg_lease_inflight = RTPU_CONFIG.max_lease_requests_in_flight
+        self._cfg_actor_inflight = RTPU_CONFIG.actor_push_max_inflight
+
+        self.server = RpcServer(host)
+        from ray_tpu._private import schema as _schema
+
+        self.server.set_validator(_schema.make_validator(_schema.WORKER_SCHEMAS))
+        self.pool = ClientPool()
+        gcs_host, gcs_port = gcs_address.rsplit(":", 1)
+        self.gcs_aio = GcsAioClient(gcs_host, int(gcs_port))
+        self.gcs = GcsClient(gcs_host, int(gcs_port), self.io)
+        self.functions = FunctionManager(self.gcs.kv_put, self.gcs.kv_get)
+
+        self.memory_store = MemoryStore()
+        self.refs = ReferenceCounter(self._on_ref_zero)
+        self.executor = Executor(self)
+        self.task_events = TaskEventBuffer(self)
+
+        self.node_id: Optional[NodeID] = None
+        self.plasma: Optional[PlasmaClient] = None
+        self.raylet: Optional[RpcClient] = None
+        self._raylet_addr = raylet_addr
+        self._startup_token = startup_token
+
+        # ownership / submission state (IO-loop only)
+        self._leases: Dict[tuple, _LeaseState] = {}
+        self._pending_tasks: Dict[bytes, dict] = {}  # task_id -> record
+        self._actor_submitters: Dict[bytes, _ActorSubmitter] = {}
+        self._subscribed_channels: set = set()
+        self._working_dir_uris: Dict[tuple, str] = {}  # (path, signature) -> kv uri
+        self._running_async: Dict[bytes, Any] = {}  # task_id -> cancellable future
+        self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
+        self._node_cache: Dict[bytes, dict] = {}
+        self._node_cache_time = 0.0
+        self._pg_node_cache: Dict[tuple, bytes] = {}  # (pg_id, idx) -> node_id
+        self._lineage: Dict[bytes, dict] = {}  # task_id -> spec (for reconstruction)
+        self._lineage_bytes = 0
+
+        # Batched thread->loop handoff: submits/frees/notifies append here
+        # and wake the io loop once per burst (a call_soon_threadsafe each
+        # costs ~0.1 ms of self-pipe + GIL churn; per-task wakeups capped
+        # submission at ~3k tasks/s — reference analogue: the Cython layer
+        # posts into the asio io_service without a per-call thread switch).
+        self._loop_work: deque = deque()
+        self._loop_work_lock = threading.Lock()
+        self._loop_work_scheduled = False
+        # executor-side reply streaming for batched actor-task pushes
+        self._reply_bufs: Dict[tuple, list] = {}
+        self._reply_flush_scheduled: set = set()
+
+        # task context for the executing thread
+        self._ctx = threading.local()
+        self._put_index_lock = threading.Lock()
+        self._put_index = 0
+        self._driver_task_id = TaskID.for_task(job_id)
+
+        self.actor_id: Optional[bytes] = None
+        self._actor_spec: Optional[dict] = None
+        self.is_shutdown = False
+
+        set_worker_hooks(self)
+        # Connect (blocking): start server, register with raylet, attach plasma.
+        self.io.run(self._connect())
+
+    # ------------------------------------------------------------- connect
+
+    async def _connect(self):
+        self.server.register_all(self)
+        self.port = await self.server.start(0)
+        if self.mode == MODE_WORKER:
+            # Adopt the driver's sys.path BEFORE the raylet can hand us a
+            # task: by-reference-pickled functions live in modules the driver
+            # can import, and fork-server children don't inherit the driver's
+            # path (reference: job_config code-search-path propagation).
+            try:
+                reply = await self.gcs_aio.call(
+                    "GetJob", {"job_id": self.job_id.binary()}
+                )
+                import sys as _sys
+
+                for p in reply.get("job", {}).get("driver_sys_path", []):
+                    if p not in _sys.path:
+                        _sys.path.append(p)
+            except Exception:
+                pass
+        self.raylet = RpcClient(*self._raylet_addr)
+        await self.raylet.connect()
+        reply = await self.raylet.call(
+            "RegisterWorker",
+            {
+                "worker_id": self.worker_id.binary(),
+                "port": self.port,
+                "pid": os.getpid(),
+                "startup_token": self._startup_token,
+                "job_id": self.job_id.binary(),
+            },
+        )
+        self.node_id = NodeID(reply["node_id"])
+        self.plasma = PlasmaClient(reply["plasma_name"])
+        self.address = (self.host, self.port)
+        asyncio.ensure_future(self._task_event_flush_loop())
+        asyncio.ensure_future(self._pubsub_loop())
+        if self.mode == MODE_WORKER:
+            asyncio.ensure_future(self._watch_raylet())
+
+    async def _watch_raylet(self):
+        """Workers die with their raylet (reference: worker <-> raylet socket)."""
+        while True:
+            await asyncio.sleep(1.0)
+            if not self.raylet.is_connected():
+                os._exit(1)
+            if os.getppid() == 1:
+                os._exit(1)
+
+    async def _task_event_flush_loop(self):
+        period = RTPU_CONFIG.task_events_flush_period_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            events = self.task_events.drain()
+            if events:
+                try:
+                    await self.gcs_aio.notify("AddTaskEvents", {"events": events})
+                except Exception:
+                    pass
+            self._flush_user_metrics()
+
+    def _flush_user_metrics(self):
+        """Push ray_tpu.util.metrics records (if that module is in use) to
+        the GCS aggregator, stamped with worker/job labels so series from
+        different workers never collide."""
+        import sys as _sys
+
+        mod = _sys.modules.get("ray_tpu.util.metrics")
+        if mod is None:
+            return
+        try:
+            records = mod.drain_records()
+        except Exception:
+            return
+        if not records:
+            return
+        wid = self.worker_id.hex()[:12]
+        jid = self.job_id.hex()
+        for rec in records:
+            rec["labels"] = {**rec["labels"], "WorkerId": wid, "JobId": jid}
+
+        async def _push():
+            try:
+                await self.gcs_aio.call(
+                    "ReportUserMetrics", {"records": records}, timeout=10
+                )
+            except Exception:
+                # Re-merge the drained deltas: a GCS blip must not lose
+                # counter increments.
+                try:
+                    mod.restore_records(records)
+                except Exception:
+                    pass
+
+        asyncio.ensure_future(_push())
+
+    # ------------------------------------------------ ObjectRef hooks (sync)
+
+    def add_local_ref(self, ref: ObjectRef):
+        oid = ref.object_id()
+        if self.refs.owns(oid):
+            self.refs.add_local_ref(oid)
+        else:
+            first = self.refs.add_borrowed_ref(oid, ref.owner_address)
+            if first and ref.owner_address and tuple(ref.owner_address) != self.address:
+                self._post_owner_notify(
+                    ref.owner_address,
+                    "AddBorrowerRef",
+                    {"object_id": oid.binary(), "borrower": list(self.address)},
+                )
+
+    def remove_local_ref(self, ref: ObjectRef):
+        if self.is_shutdown:
+            return
+        oid = ref.object_id()
+        if self.refs.owns(oid):
+            self.refs.remove_local_ref(oid)
+        else:
+            owner = self.refs.remove_borrowed_ref(oid)
+            if owner and tuple(owner) != self.address:
+                self._post_owner_notify(
+                    owner,
+                    "RemoveBorrowerRef",
+                    {"object_id": oid.binary(), "borrower": list(self.address)},
+                )
+
+    def _post_batched(self, kind: str, item):
+        """Queue loop-side work from a foreign thread with one io-loop
+        wakeup per burst instead of one run_coroutine_threadsafe per call."""
+        with self._loop_work_lock:
+            self._loop_work.append((kind, item))
+            if self._loop_work_scheduled:
+                return
+            self._loop_work_scheduled = True
+        try:
+            self.io.loop.call_soon_threadsafe(self._drain_loop_work)
+        except RuntimeError:
+            pass  # loop closed (shutdown)
+
+    def _drain_loop_work(self):
+        """Runs on the io loop: route every queued item, then kick each
+        touched pump exactly once."""
+        with self._loop_work_lock:
+            work = self._loop_work
+            self._loop_work = deque()
+            self._loop_work_scheduled = False
+        normal_states: Dict[tuple, _LeaseState] = {}
+        actor_subs: Dict[bytes, _ActorSubmitter] = {}
+        frees: list = []
+        for kind, item in work:
+            if kind == "normal":
+                key = ts.scheduling_key(item)
+                state = self._leases.setdefault(key, _LeaseState())
+                state.queue.append(item)
+                normal_states[key] = state
+            elif kind == "actor":
+                actor_id, spec = item
+                sub = self._route_actor_spec(actor_id, spec)
+                if sub is not None:
+                    actor_subs[actor_id] = sub
+            elif kind == "free":
+                frees.append(item)
+            else:  # notify
+                owner_addr, method, payload = item
+                asyncio.ensure_future(
+                    self._notify_owner(owner_addr, method, payload)
+                )
+        for key, state in normal_states.items():
+            asyncio.ensure_future(self._pump_leases(key, state))
+        for sub in actor_subs.values():
+            self._pump_actor(sub)
+        if frees:
+            asyncio.ensure_future(self._free_refs_batch(frees))
+
+    async def _notify_owner(self, owner_addr, method, payload):
+        try:
+            client = await self.pool.get(owner_addr[0], owner_addr[1])
+            await client.notify(method, payload)
+        except Exception:
+            pass
+
+    def _post_owner_notify(self, owner_addr, method, payload):
+        self._post_batched("notify", (owner_addr, method, payload))
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        def done(task):
+            try:
+                out.set_result(self.get([ref], timeout=None)[0])
+            except Exception as e:
+                out.set_exception(e)
+
+        f = self.io.post(self._async_resolve(ref, None))
+        f.add_done_callback(done)
+        return out
+
+    async def await_ref(self, ref: ObjectRef):
+        res = await self._async_resolve(ref, None)
+        value = self._materialize(ref.object_id(), res)
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    def _on_ref_zero(self, oid: ObjectID):
+        """Owned object's refcount hit zero: free it everywhere."""
+        self._post_batched("free", oid)
+
+    async def _free_refs_batch(self, oids):
+        """Free a burst of dead objects: local stores synchronously, then
+        one FreeObjects notify per holding node for the whole batch."""
+        by_node: Dict[bytes, list] = {}
+        for oid in oids:
+            entry = self.memory_store.get_if_exists(oid)
+            self.memory_store.free(oid)
+            locations = self._object_locations.pop(oid.binary(), set())
+            if isinstance(entry, InPlasma):
+                locations |= entry.locations
+            for node_id in locations:
+                by_node.setdefault(node_id, []).append(oid.binary())
+        for node_id, ids in by_node.items():
+            info = await self._node_info(node_id)
+            if info is None:
+                continue
+            try:
+                client = await self.pool.get(info["ip"], info["raylet_port"])
+                await client.notify("FreeObjects", {"ids": ids})
+            except Exception:
+                pass
+
+    async def _node_info(self, node_id: bytes) -> Optional[dict]:
+        now = time.time()
+        if node_id not in self._node_cache or now - self._node_cache_time > 5.0:
+            try:
+                nodes = await self.gcs_aio.get_all_node_info()
+                self._node_cache = {n["node_id"]: n for n in nodes}
+                self._node_cache_time = now
+            except Exception:
+                pass
+        return self._node_cache.get(node_id)
+
+    # ------------------------------------------------------------ put / get
+
+    def _next_put_id(self) -> ObjectID:
+        with self._put_index_lock:
+            self._put_index += 1
+            idx = self._put_index
+        return ObjectID.for_put(self.current_task_id(), idx)
+
+    def current_task_id(self) -> TaskID:
+        spec = getattr(self._ctx, "spec", None)
+        if spec is not None:
+            return TaskID(spec["task_id"])
+        return self._driver_task_id
+
+    def put(self, value: Any, _owner_hint=None) -> ObjectRef:
+        """Store a value, return an owned ref (reference: worker.py:2691 ray.put)."""
+        oid = self._next_put_id()
+        payload, _refs = serialization.serialize_inline(value)
+        size = len(payload["p"]) + sum(len(b) for b in payload["b"])
+        self.refs.add_owned(oid)
+        if size <= self.inline_threshold:
+            self.io.run(self._store_inline(oid, payload))
+        else:
+            nbytes = self._plasma_put_payload(oid, payload)
+            self.io.run(self._register_plasma_primary(oid, nbytes))
+        return ObjectRef(oid, self.address)
+
+    async def _store_inline(self, oid: ObjectID, payload):
+        self.memory_store.put(oid, (_INLINE, payload, None))
+
+    def _plasma_put_payload(self, oid: ObjectID, payload) -> int:
+        """Serialize straight into the shared-memory buffer: one copy total
+        (reference plasma clients do the same via Create+mutable buffer,
+        plasma/client.cc). Returns the object's byte size."""
+        size = serialization.blob_size(payload["p"], payload["b"])
+        try:
+            dest = self.plasma.create(oid, size)
+        except FileExistsError:
+            if self.plasma.contains(oid):
+                return size  # already sealed by an earlier attempt
+            # Unsealed leftover from a crashed/failed writer: readers would
+            # block on it forever. Reclaim and rewrite.
+            self.plasma.abort(oid)
+            dest = self.plasma.create(oid, size)
+        except PlasmaOOM:
+            # Make room: evict unpinned secondaries, then ask the raylet to
+            # spill pinned primaries to disk (reference: CreateRequestQueue
+            # retries + LocalObjectManager spilling). Spilled memory may free
+            # only after concurrent readers release their views, so retry
+            # with backoff before giving up.
+            dest = None
+            for attempt in range(6):
+                self.plasma.evict(size)
+                try:
+                    dest = self.plasma.create(oid, size)
+                    break
+                except PlasmaOOM:
+                    try:
+                        self.io.run(
+                            self.raylet.call(
+                                "SpillObjects", {"bytes": size}, timeout=60
+                            )
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.1 * (attempt + 1))
+            if dest is None:
+                dest = self.plasma.create(oid, size)  # raise the real OOM
+        try:
+            serialization.write_blob(dest, payload["p"], payload["b"])
+            dest.release()
+            self.plasma.seal(oid)
+        except BaseException:
+            # Never leave a created-but-unsealed object behind.
+            try:
+                dest.release()
+            except Exception:
+                pass
+            self.plasma.abort(oid)
+            raise
+        return size
+
+    async def _register_plasma_primary(self, oid: ObjectID, size: int):
+        node = self.node_id.binary()
+        self.memory_store.put(oid, InPlasma(size, {node}))
+        self._object_locations.setdefault(oid.binary(), set()).add(node)
+        try:
+            # Synchronous: until the pin lands, a concurrent put's evict()
+            # could reclaim this primary and lose the object.
+            await self.raylet.call(
+                "PinObject",
+                {"object_id": oid.binary(), "owner_addr": list(self.address)},
+                timeout=30,
+            )
+        except Exception:
+            pass
+
+    # -- get ---------------------------------------------------------------
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        resolutions = self.io.run(self._async_resolve_many(refs, deadline))
+        out = []
+        for ref, res in zip(refs, resolutions):
+            value = self._materialize(ref.object_id(), res)
+            if isinstance(value, ObjectLostError) and res[0] == "plasma_local":
+                # Spilled between resolution and read: resolve again (the
+                # raylet restores it from disk).
+                res = self.io.run(self._async_resolve(ref, deadline))
+                value = self._materialize(ref.object_id(), res)
+            if isinstance(value, Exception):
+                raise value
+            out.append(value)
+        return out
+
+    async def async_get_one(self, ref: ObjectRef):
+        """IO-loop get used by the executor for dependency resolution."""
+        res = await self._async_resolve(ref, None)
+        loop = asyncio.get_running_loop()
+        value = await loop.run_in_executor(None, self._materialize, ref.object_id(), res)
+        if isinstance(value, ObjectLostError) and res[0] == "plasma_local":
+            res = await self._async_resolve(ref, None)
+            value = await loop.run_in_executor(
+                None, self._materialize, ref.object_id(), res
+            )
+        if isinstance(value, Exception):
+            raise value
+        return value
+
+    async def _async_resolve_many(self, refs, deadline):
+        # One batch event covers every owned-pending ref (per-ref
+        # gather+wait_for costs a Task + timer + Event each, ~150 µs/ref on
+        # a 1000-ref get); only stragglers (borrowed, plasma, errors) take
+        # the per-ref coroutine path.
+        if len(refs) > 1:
+            pending = [
+                r.object_id() for r in refs
+                if self.memory_store.is_pending(r.object_id())
+            ]
+            if pending:
+                timeout = None if deadline is None else max(0.0, deadline - time.time())
+                await self.memory_store.wait_ready_many(pending, timeout)
+        results = [None] * len(refs)
+        slow = []
+        for i, r in enumerate(refs):
+            oid = r.object_id()
+            entry = self.memory_store.get_if_exists(oid)
+            if entry is not None and not isinstance(entry, InPlasma):
+                results[i] = (
+                    entry[:2] if entry[0] in (_INLINE, _ERR) else ("value", entry)
+                )
+            else:
+                slow.append(i)
+        if slow:
+            resolved = await asyncio.gather(
+                *(self._async_resolve(refs[i], deadline) for i in slow)
+            )
+            for i, res in zip(slow, resolved):
+                results[i] = res
+        return results
+
+    async def _async_resolve(self, ref: ObjectRef, deadline) -> tuple:
+        """Resolve a ref to ('inline'|'err', payload) | ('plasma_local', oid) on IO loop."""
+        oid = ref.object_id()
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.refs.owns(oid) or self.memory_store.contains(oid) or self.memory_store.is_pending(oid):
+                res = await self._resolve_owned(oid, deadline)
+            else:
+                res = await self._resolve_borrowed(ref, deadline)
+            if res[0] != "plasma_remote_lost":
+                return res
+            # All copies lost: try lineage reconstruction
+            # (reference: object_recovery_manager.h:41).
+            if attempt > 2 or not await self._try_reconstruct(oid):
+                return ("err_obj", ObjectLostError(f"object {oid.hex()} lost (all copies gone)"))
+
+    async def _resolve_owned(self, oid: ObjectID, deadline) -> tuple:
+        timeout = None if deadline is None else max(0.0, deadline - time.time())
+        ready = await self.memory_store.wait_ready(oid, timeout)
+        if not ready:
+            return ("err_obj", GetTimeoutError(f"get() timed out on {oid.hex()}"))
+        entry = self.memory_store.get_if_exists(oid)
+        if entry is None:
+            return ("err_obj", ObjectLostError(f"object {oid.hex()} was freed"))
+        if isinstance(entry, InPlasma):
+            return await self._resolve_plasma(oid, entry.locations, None, deadline)
+        return entry[:2] if entry[0] in (_INLINE, _ERR) else ("value", entry)
+
+    async def _resolve_borrowed(self, ref: ObjectRef, deadline) -> tuple:
+        oid = ref.object_id()
+        owner = ref.owner_address
+        if owner is None:
+            return ("err_obj", OwnerDiedError(f"no owner known for {oid.hex()}"))
+        while True:
+            timeout = 25.0
+            if deadline is not None:
+                timeout = min(timeout, deadline - time.time())
+                if timeout <= 0:
+                    return ("err_obj", GetTimeoutError(f"get() timed out on {oid.hex()}"))
+            try:
+                client = await self.pool.get(owner[0], owner[1])
+                status = await client.call(
+                    "GetObjectStatus",
+                    {"object_id": oid.binary(), "wait": True, "timeout": timeout},
+                    timeout=timeout + 5,
+                )
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                return ("err_obj", OwnerDiedError(f"owner of {oid.hex()} is unreachable"))
+            st = status.get("status")
+            if st == "pending":
+                continue
+            if st == "freed":
+                return ("err_obj", ObjectLostError(f"object {oid.hex()} was freed by owner"))
+            if "inline" in status:
+                return (_INLINE, status["inline"])
+            if "err" in status:
+                return (_ERR, status["err"])
+            if "plasma" in status:
+                return await self._resolve_plasma(
+                    oid, set(status["plasma"]["locations"]), owner, deadline
+                )
+
+    async def _resolve_plasma(self, oid: ObjectID, locations, owner, deadline) -> tuple:
+        if self.plasma.contains(oid):
+            return ("plasma_local", oid)
+        owner_addr = list(owner) if owner else list(self.address)
+        # A pull can fail transiently (restore-from-spill racing store
+        # pressure, holder mid-eviction): retry before declaring the copy
+        # lost — put objects have no lineage to fall back on.
+        for attempt in range(3):
+            try:
+                timeout = None if deadline is None else max(0.1, deadline - time.time())
+                reply = await self.raylet.call(
+                    "PullObject",
+                    {"object_id": oid.binary(), "owner_addr": owner_addr},
+                    timeout=timeout,
+                )
+            except asyncio.TimeoutError:
+                return ("err_obj", GetTimeoutError(f"get() timed out pulling {oid.hex()}"))
+            if reply.get("ok") and self.plasma.contains(oid):
+                return ("plasma_local", oid)
+            if deadline is not None and time.time() >= deadline:
+                break
+            await asyncio.sleep(0.2 * (attempt + 1))
+        return ("plasma_remote_lost", oid)
+
+    def _materialize(self, oid: ObjectID, res: tuple):
+        """User-thread side: turn a resolution into a Python value (may raise)."""
+        kind = res[0]
+        if kind == "value":
+            return res[1]
+        if kind == "err_obj":
+            return res[1]
+        if kind == _INLINE:
+            value, _refs = serialization.deserialize_inline(res[1])
+            return value
+        if kind == _ERR:
+            exc, _refs = serialization.deserialize_inline(res[1])
+            if isinstance(exc, RayTpuError) and not isinstance(exc, TaskError):
+                # System failures (worker crash, OOM kill, actor death...)
+                # surface as their own type; only user exceptions wrap in
+                # TaskError (reference: RayTaskError vs RaySystemError).
+                return exc
+            if isinstance(exc, Exception):
+                return TaskError(exc, getattr(exc, "_rtpu_tb", str(exc)))
+            return TaskError(Exception(str(exc)), str(exc))
+        if kind == "plasma_local":
+            return self._read_plasma_value(oid)
+        raise RuntimeError(f"bad resolution {res}")
+
+    def _read_plasma_value(self, oid: ObjectID):
+        view = self.plasma.get(oid)
+        if view is None:
+            return ObjectLostError(f"object {oid.hex()} evicted before read")
+        import struct as _struct
+
+        src = view
+        magic, plen = _struct.unpack_from("<II", src, 0)
+        off = 8
+        pickle_bytes = bytes(src[off : off + plen])
+        off += plen
+        (nbuf,) = _struct.unpack_from("<I", src, off)
+        off += 4
+        if nbuf == 0:
+            view.release()
+            self.plasma.release(oid)
+            value, _ = serialization.deserialize(pickle_bytes, [])
+            return value
+
+        def release():
+            try:
+                view.release()
+            except Exception:
+                pass
+            self.plasma.release(oid)
+
+        handle = _PinHandle(release)
+        buffers = []
+        for _ in range(nbuf):
+            (blen,) = _struct.unpack_from("<Q", src, off)
+            off += 8
+            off = (off + 63) & ~63
+            buffers.append(PlasmaValueBuffer(src[off : off + blen], handle))
+            off += blen
+        value, _refs = serialization.deserialize(pickle_bytes, buffers)
+        del buffers
+        return value
+
+    # ------------------------------------------------------------ wait
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        deadline = None if timeout is None else time.time() + timeout
+        return self.io.run(self._async_wait(refs, num_returns, deadline, fetch_local))
+
+    async def _async_wait(self, refs, num_returns, deadline, fetch_local):
+        """Event-driven wait: one waiter per pending ref. Owned refs ride the
+        memory-store per-object event; borrowed refs long-poll their owner
+        with wait=True (the owner's GetObjectStatus blocks server-side until
+        the object resolves) — no fixed-interval polling in either path
+        (reference: core_worker Wait is a callback on object availability,
+        src/ray/core_worker/core_worker.cc Wait)."""
+        ready: List[ObjectRef] = []
+        pending: List[ObjectRef] = []
+        for ref in refs:
+            if await self._is_ready(ref):
+                ready.append(ref)
+            else:
+                pending.append(ref)
+        if len(ready) >= num_returns or not pending:
+            # cap at num_returns (reference semantics); surplus ready refs
+            # stay in pending, still in input order
+            surplus = ready[num_returns:]
+            ready = ready[:num_returns]
+            if surplus:
+                keep = set(surplus) | set(pending)
+                pending = [r for r in refs if r in keep]
+            return ready, pending
+        waiters = {
+            asyncio.ensure_future(self._wait_one(ref)): ref
+            for ref in pending
+        }
+        try:
+            while len(ready) < num_returns and waiters:
+                timeout = (
+                    None if deadline is None
+                    else max(0.0, deadline - time.time())
+                )
+                done, _ = await asyncio.wait(
+                    waiters.keys(), timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    break  # deadline
+                for t in done:
+                    ready.append(waiters.pop(t))
+        finally:
+            for t in waiters:
+                t.cancel()
+        # Never return MORE than num_returns ready refs (reference
+        # semantics: len(ready) <= num_returns) — several waiters can
+        # complete in one asyncio.wait round; the surplus goes back to
+        # pending so callers looping wait(num_returns=1) see every ref.
+        ready_set = set(ready)
+        ordered_ready = [r for r in refs if r in ready_set]
+        ready = ordered_ready[:num_returns]
+        ready_set = set(ready)
+        pending = [r for r in refs if r not in ready_set]
+        return ready, pending
+
+    async def _wait_one(self, ref: ObjectRef) -> None:
+        """Resolves when the ref is ready (value, plasma copy, or error)."""
+        oid = ref.object_id()
+        while True:
+            if await self._is_ready(ref):
+                return
+            if self.memory_store.is_pending(oid):
+                await self.memory_store.wait_ready(oid, None)
+                continue
+            if self.refs.owns(oid):
+                # owned but not yet registered as pending (submit in flight)
+                await asyncio.sleep(0.01)
+                continue
+            owner = ref.owner_address
+            if owner is None:
+                await asyncio.sleep(0.01)
+                continue
+            try:
+                client = await self.pool.get(owner[0], owner[1])
+                status = await client.call(
+                    "GetObjectStatus",
+                    {"object_id": oid.binary(), "wait": True, "timeout": 30},
+                    timeout=35,
+                )
+                if status.get("status") != "pending":
+                    return  # ready / freed / error — all count as resolved
+            except Exception:
+                await asyncio.sleep(0.1)
+
+    async def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.object_id()
+        if self.memory_store.contains(oid):
+            return True
+        if self.memory_store.is_pending(oid):
+            return False
+        if self.plasma.contains(oid):
+            return True
+        if self.refs.owns(oid):
+            return False
+        owner = ref.owner_address
+        if owner is None:
+            return False
+        try:
+            client = await self.pool.get(owner[0], owner[1])
+            status = await client.call(
+                "GetObjectStatus", {"object_id": oid.binary(), "wait": False}, timeout=10
+            )
+            return status.get("status") == "ready" or "inline" in status or "plasma" in status or "err" in status
+        except Exception:
+            return False
+
+    # ----------------------------------------------------- normal task submit
+
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: Dict[str, float],
+        max_retries: int = 0,
+        retry_exceptions: bool = False,
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        fn_key = self.functions.export(fn)
+        runtime_env = self.prepare_runtime_env(runtime_env)
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_task(self.job_id)
+        from ray_tpu.util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name,
+            fn_key=fn_key,
+            wire_args=wire,
+            num_returns=num_returns,
+            resources=resources,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env,
+        )
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
+        return_refs = self._register_pending(spec, refs)
+        self._post_batched("normal", spec)
+        return return_refs
+
+    def prepare_runtime_env(self, runtime_env: Optional[dict]) -> Optional[dict]:
+        """Validate and materialize a runtime_env for shipping in a spec.
+
+        A local working_dir path is zipped and uploaded to the GCS KV once
+        per content hash (reference: runtime_env/packaging.py); the spec
+        carries the kv:<hash> URI so any node can extract it.
+        """
+        runtime_env = ts.validate_runtime_env(runtime_env)
+        if not runtime_env:
+            return runtime_env
+
+        def upload_dir(path: str, arc_prefix: str = "") -> str:
+            # Cache by content signature, not path: edits to the directory
+            # between submits must produce a fresh upload.
+            cache_key = (
+                os.path.abspath(path), renv.dir_signature(path), arc_prefix
+            )
+            uri = self._working_dir_uris.get(cache_key)
+            if uri is None:
+                uri = renv.upload_working_dir(self.gcs, path, arc_prefix)
+                self._working_dir_uris[cache_key] = uri
+            return uri
+
+        wd = runtime_env.get("working_dir")
+        if wd and not renv.is_uploaded(wd):
+            runtime_env = {**runtime_env, "working_dir": upload_dir(wd)}
+        pm = runtime_env.get("py_modules")
+        if pm:
+            # py_modules ride the working_dir packaging machinery, nested
+            # under the module dir's basename so `import <basename>` works
+            # from the extracted root (reference: py_modules contract,
+            # runtime_env packaging.py)
+            runtime_env = {**runtime_env, "py_modules": [
+                p if renv.is_uploaded(p)
+                else upload_dir(p, os.path.basename(os.path.abspath(p)))
+                for p in pm
+            ]}
+        return runtime_env
+
+    def _replace_large_args(self, wire, large) -> List[ObjectRef]:
+        """Oversized inline args are put() first and passed by ref
+        (reference: dependency_resolver.h inlining threshold)."""
+        big_refs = []
+        if not large:
+            return big_refs
+        by_key = {}
+        for pos_key, val in large:
+            ref = self.put(val)
+            big_refs.append(ref)
+            by_key[pos_key] = ref
+        for entry in wire:
+            w = entry[2]
+            if "big" in w:
+                key = tuple(w["big"])
+                ref = by_key[(key[0], key[1] if key[0] == "k" else int(key[1]))]
+                entry[2] = {"ref": [ref.object_id().binary(), list(ref.owner_address)]}
+        return big_refs
+
+    def _register_pending(self, spec: dict, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
+        return_ids = ts.return_object_ids(spec)
+        out = []
+        for oid in return_ids:
+            self.refs.add_owned(oid, lineage_task_id=spec["task_id"])
+        # Direct call, not io.run: a cross-thread round-trip here costs ~1 ms
+        # per .remote() and caps submission at <1k tasks/s. put_pending only
+        # creates dict entries + an (unbound) asyncio.Event — safe under the
+        # GIL; the result cannot arrive before the spec is posted below.
+        for oid in return_ids:
+            self.memory_store.put_pending(oid)
+        for oid in return_ids:
+            out.append(ObjectRef(oid, self.address))
+        for ref in arg_refs:
+            if self.refs.owns(ref.object_id()):
+                self.refs.add_submitted_task_ref(ref.object_id())
+        self._pending_tasks[spec["task_id"]] = {
+            "spec": spec,
+            "retries": spec.get("max_retries", 0),
+            "arg_refs": list(arg_refs),
+            "return_ids": return_ids,
+        }
+        self.task_events.record(spec, "PENDING")
+        return out
+
+    async def _submit_normal(self, spec: dict):
+        key = ts.scheduling_key(spec)
+        state = self._leases.setdefault(key, _LeaseState())
+        state.queue.append(spec)
+        await self._pump_leases(key, state)
+
+    async def _pump_leases(self, key, state: _LeaseState):
+        while state.queue and state.idle:
+            lease = state.idle.popleft()
+            spec = state.queue.popleft()
+            asyncio.ensure_future(self._push_on_lease(key, state, lease, spec))
+        # Bound in-flight lease requests: beyond a handful they only pile up
+        # in the raylet's waiter queue while costing an RPC each.
+        need = min(
+            len(state.queue) - state.requests_in_flight,
+            self._cfg_lease_inflight - state.requests_in_flight,
+        )
+        for _ in range(need):
+            state.requests_in_flight += 1
+            asyncio.ensure_future(self._request_lease(key, state))
+
+    async def _request_lease(self, key, state: _LeaseState, raylet_client=None, hops=0):
+        try:
+            if not state.queue:
+                return
+            sample = state.queue[0]
+            client = raylet_client
+            if client is None and sample["strategy"].get("type") == "placement_group":
+                # PG tasks lease directly from the raylet holding the bundle
+                # (the local raylet has no view of remote bundle placement).
+                client = await self._pg_raylet(sample["strategy"])
+                if client is None:
+                    err = RuntimeError(
+                        "placement group not found or never became ready"
+                    )
+                    while state.queue:
+                        self._fail_task(state.queue.popleft(), err)
+                    return
+            if client is None:
+                client = self.raylet
+            try:
+                reply = await client.call(
+                    "RequestWorkerLease",
+                    {
+                        "resources": sample["resources"],
+                        "strategy": sample["strategy"],
+                        "job_id": sample["job_id"],
+                        "runtime_env": sample.get("runtime_env") or {},
+                    },
+                    timeout=RTPU_CONFIG.worker_lease_timeout_ms / 1000.0 + 10,
+                )
+            except (ConnectionLost, OSError, asyncio.TimeoutError):
+                if raylet_client is not None:
+                    # spill target died; go back to local raylet
+                    state.requests_in_flight += 1
+                    asyncio.ensure_future(self._request_lease(key, state))
+                return
+            if reply.get("granted"):
+                lease = {
+                    "worker_addr": tuple(reply["worker_addr"]),
+                    "worker_id": reply["worker_id"],
+                    "lease_id": reply["lease_id"],
+                    "raylet": client,
+                }
+                state.all_leases.add(reply["lease_id"])
+                if state.queue:
+                    spec = state.queue.popleft()
+                    asyncio.ensure_future(self._push_on_lease(key, state, lease, spec))
+                else:
+                    await self._return_lease(state, lease)
+            elif reply.get("spill"):
+                target = reply["spill"]
+                peer = await self.pool.get(target["ip"], target["port"])
+                state.requests_in_flight += 1
+                if hops < 4:
+                    asyncio.ensure_future(self._request_lease(key, state, peer, hops + 1))
+                else:
+                    asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("retry"):
+                state.requests_in_flight += 1
+                asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("retry_pg"):
+                # Bundle not (yet) committed on the raylet we picked: drop the
+                # cached placement and re-resolve from GCS — bounded, so a
+                # commit that never lands fails the task instead of spinning.
+                deadline = sample.setdefault(
+                    "_pg_retry_deadline",
+                    time.time() + RTPU_CONFIG.placement_group_ready_timeout_s,
+                )
+                if time.time() > deadline:
+                    err = RuntimeError(
+                        "placement group bundle never became available"
+                    )
+                    while state.queue:
+                        self._fail_task(state.queue.popleft(), err)
+                    return
+                pg_key = (sample["strategy"]["pg_id"],
+                          sample["strategy"].get("bundle_index") or 0)
+                self._pg_node_cache.pop(pg_key, None)
+                await asyncio.sleep(0.2)
+                state.requests_in_flight += 1
+                asyncio.ensure_future(self._request_lease(key, state))
+            elif reply.get("error"):
+                err = RuntimeError(reply["error"])
+                while state.queue:
+                    spec = state.queue.popleft()
+                    self._fail_task(spec, err)
+        finally:
+            state.requests_in_flight -= 1
+
+    async def _pg_raylet(self, strategy: dict):
+        """Resolve the raylet hosting this task's PG bundle, waiting for the
+        group to finish its 2PC if needed. Returns None if the PG is gone."""
+        pg_key = (strategy["pg_id"], strategy.get("bundle_index") or 0)
+        node_id = self._pg_node_cache.get(pg_key)
+        if node_id is None:
+            # Event-driven: the GCS blocks this call until the 2PC finishes
+            # (WaitPlacementGroupReady arms a server-side event) — no
+            # client-side polling interval. Transient RPC failures (GCS
+            # restart) retry until the ready deadline; only an authoritative
+            # "removed"/timeout answer fails the tasks.
+            deadline = time.time() + RTPU_CONFIG.placement_group_ready_timeout_s
+            while True:
+                left = deadline - time.time()
+                if left <= 0:
+                    return None
+                try:
+                    reply = await self.gcs_aio.call(
+                        "WaitPlacementGroupReady",
+                        {"pg_id": pg_key[0], "timeout": left},
+                        timeout=left + 10,
+                    )
+                except RemoteError:
+                    return None  # GCS answered: the PG is removed
+                except Exception:
+                    await asyncio.sleep(0.5)  # transient; GCS may be restarting
+                    continue
+                if not reply.get("ready"):
+                    return None
+                break
+            info = await self.gcs_aio.call(
+                "GetPlacementGroup", {"pg_id": pg_key[0]}
+            )
+            if not info.get("found") or info["pg"]["state"] != "CREATED":
+                return None
+            node_id = info["pg"]["bundles"][pg_key[1]]["node_id"]
+            self._pg_node_cache[pg_key] = node_id
+        info = await self._node_info(node_id)
+        if info is None:
+            self._pg_node_cache.pop(pg_key, None)
+            return None
+        return await self.pool.get(info["ip"], info["raylet_port"])
+
+    async def _push_on_lease(self, key, state: _LeaseState, lease, spec: dict):
+        # Adaptive batching: when the queue is deep relative to the number of
+        # leased workers, ship several tasks per RPC — the Python control
+        # plane is message-count-bound (~0.25 ms/message), so tiny-task
+        # throughput scales with batch size. A shallow queue keeps batch=1 so
+        # sparse/long tasks keep per-task latency and full parallelism.
+        batch = [spec]
+        # Divide the queue by workers we have OR expect (outstanding lease
+        # requests), so early grants don't hoard the queue and starve the
+        # leases that are about to arrive.
+        expected_workers = max(
+            1, len(state.all_leases) + state.requests_in_flight
+        )
+        extra = min(
+            len(state.queue) // expected_workers,
+            self._cfg_push_batch - 1,
+        )
+        for _ in range(extra):
+            if not state.queue:
+                break
+            batch.append(state.queue.popleft())
+        try:
+            client = await self.pool.get(*lease["worker_addr"])
+            for s in batch:
+                self._pending_tasks.get(s["task_id"], {})["lease"] = lease
+                self.task_events.record(s, "SUBMITTED")
+            if len(batch) == 1:
+                replies = [await client.call(
+                    "PushTask", {"spec": spec}, timeout=None
+                )]
+            else:
+                r = await client.call(
+                    "PushTasks", {"specs": batch}, timeout=None
+                )
+                replies = r["replies"]
+        except (ConnectionLost, OSError) as e:
+            state.all_leases.discard(lease["lease_id"])
+            for s in batch:
+                await self._handle_worker_crash(s, e)
+            await self._pump_leases(key, state)
+            return
+        for s, rep in zip(batch, replies):
+            await self._process_task_reply(s, rep)
+        # reuse the lease for queued work, else return it
+        if state.queue:
+            next_spec = state.queue.popleft()
+            asyncio.ensure_future(self._push_on_lease(key, state, lease, next_spec))
+        else:
+            await self._return_lease(state, lease)
+
+    async def _return_lease(self, state: _LeaseState, lease):
+        state.all_leases.discard(lease["lease_id"])
+        try:
+            await lease["raylet"].notify(
+                "ReturnWorker", {"worker_id": lease["worker_id"], "lease_id": lease["lease_id"]}
+            )
+        except Exception:
+            pass
+
+    async def _handle_worker_crash(self, spec: dict, err):
+        record = self._pending_tasks.get(spec["task_id"])
+        if record and record["retries"] > 0:
+            record["retries"] -= 1
+            self.task_events.record(spec, "RETRY")
+            await self._submit_normal(spec)
+        else:
+            error: Exception = WorkerCrashedError(
+                f"worker died executing {spec['name']}: {err}"
+            )
+            # If the raylet's memory monitor killed the worker, surface the
+            # real cause (reference: OOM deaths raise ray.exceptions.
+            # OutOfMemoryError, task_manager failure-cause plumbing).
+            lease = (record or {}).get("lease")
+            if lease:
+                try:
+                    await asyncio.sleep(0.3)  # let the death report land
+                    r = await self.gcs_aio.call(
+                        "GetWorkerFailures", {"limit": 200}, timeout=5
+                    )
+                    for f in reversed(r.get("failures", [])):
+                        if f.get("worker_id") == lease["worker_id"]:
+                            if "memory monitor" in f.get("reason", ""):
+                                error = OutOfMemoryError(
+                                    f"task {spec['name']} failed: {f['reason']}"
+                                )
+                            break
+                except Exception:
+                    pass
+            self._fail_task(spec, error)
+
+    def _fail_task(self, spec: dict, error: Exception):
+        record = self._pending_tasks.pop(spec["task_id"], None)
+        payload, _ = serialization.serialize_inline(error)
+        for oid in ts.return_object_ids(spec):
+            self.memory_store.put(oid, (_ERR, payload, None))
+        self.task_events.record(spec, "FAILED", error=str(error)[:500])
+        if record:
+            self._release_task_arg_refs(record)
+
+    def _release_task_arg_refs(self, record):
+        for ref in record.get("arg_refs", []):
+            if self.refs.owns(ref.object_id()):
+                self.refs.remove_submitted_task_ref(ref.object_id())
+        record["arg_refs"] = []
+
+    async def _process_task_reply(self, spec: dict, reply: dict):
+        record = self._pending_tasks.get(spec["task_id"])
+        if reply.get("status") == "error":
+            if reply.get("app_error") and spec.get("retry_exceptions") and record and record["retries"] > 0:
+                record["retries"] -= 1
+                await self._submit_normal(spec)
+                return
+            if reply.get("cancelled"):
+                err_payload, _ = serialization.serialize_inline(TaskCancelledError())
+            elif "exception" in reply:
+                err_payload = reply["exception"]
+            else:
+                err_payload, _ = serialization.serialize_inline(RuntimeError(reply.get("error", "task failed")))
+            for oid in ts.return_object_ids(spec):
+                self.memory_store.put(oid, (_ERR, err_payload, None))
+            self.task_events.record(spec, "FAILED", error=str(reply.get("error", ""))[:300])
+        else:
+            return_ids = ts.return_object_ids(spec)
+            any_plasma = False
+            for oid, result in zip(return_ids, reply["results"]):
+                if "inline" in result:
+                    self.memory_store.put(oid, (_INLINE, result["inline"], None))
+                elif "plasma" in result:
+                    meta = result["plasma"]
+                    any_plasma = True
+                    self.memory_store.put(oid, InPlasma(meta["size"], {meta["node_id"]}))
+                    self._object_locations.setdefault(oid.binary(), set()).add(meta["node_id"])
+            if any_plasma:
+                self._store_lineage(spec)
+        self._pending_tasks.pop(spec["task_id"], None)
+        if record:
+            self._release_task_arg_refs(record)
+
+    def _store_lineage(self, spec: dict):
+        """Keep specs that can recreate lost plasma returns
+        (reference: task_manager.h:208 lineage, :215 max_lineage_bytes)."""
+        est = 256 + sum(len(str(a)) for a in spec.get("args", []))
+        if self._lineage_bytes + est > RTPU_CONFIG.max_lineage_bytes:
+            return
+        self._lineage[spec["task_id"]] = spec
+        self._lineage_bytes += est
+
+    async def _try_reconstruct(self, oid: ObjectID) -> bool:
+        task_id = oid.task_id().binary()
+        spec = self._lineage.get(task_id)
+        if spec is None:
+            return False
+        self.memory_store.free(oid)
+        for rid in ts.return_object_ids(spec):
+            self.memory_store.put_pending(rid)
+        self._pending_tasks[spec["task_id"]] = {
+            "spec": spec, "retries": 0, "arg_refs": [], "return_ids": ts.return_object_ids(spec),
+        }
+        await self._submit_normal(spec)
+        return True
+
+    # ----------------------------------------------------------- actor submit
+
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        *,
+        name: str = "",
+        namespace: str = "",
+        num_returns: int = 0,
+        resources: Dict[str, float],
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        lifetime: str = "",
+        scheduling_strategy: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> bytes:
+        actor_id = ActorID.of(self.job_id)
+        fn_key = self.functions.export(cls)
+        runtime_env = self.prepare_runtime_env(runtime_env)
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=f"{name or getattr(cls, '__name__', 'Actor')}.__init__",
+            fn_key=fn_key,
+            wire_args=wire,
+            num_returns=0,
+            resources=resources,
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            scheduling_strategy=scheduling_strategy,
+            task_type=ts.TASK_ACTOR_CREATION,
+            actor_id=actor_id,
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            caller_id=self.worker_id.binary(),
+            runtime_env=runtime_env,
+        )
+        # Hold arg refs until creation completes (GCS drives creation).
+        sub = _ActorSubmitter(actor_id.binary())
+        sub.state = "PENDING_CREATION"
+        self._actor_submitters[actor_id.binary()] = sub
+        self.gcs.call(
+            "RegisterActor",
+            {
+                "actor_id": actor_id.binary(),
+                "creation_spec": spec,
+                "name": name,
+                "namespace": namespace,
+                "max_restarts": max_restarts,
+                "detached": lifetime == "detached",
+            },
+        )
+        self.io.post(self._watch_actor(actor_id.binary()))
+        # keep creation arg refs alive until ALIVE (bound to submitter)
+        sub.creation_refs = refs  # type: ignore[attr-defined]
+        return actor_id.binary()
+
+    def submit_actor_task(
+        self, actor_id: bytes, method_name: str, args, kwargs, *, num_returns=1, name=""
+    ) -> List[ObjectRef]:
+        wire, refs, large = ts.serialize_args(args, kwargs, self.inline_threshold)
+        big_refs = self._replace_large_args(wire, large)
+        refs.extend(big_refs)
+        task_id = TaskID.for_task(self.job_id)
+        spec = ts.build_task_spec(
+            task_id=task_id,
+            job_id=self.job_id,
+            name=name or method_name,
+            fn_key=b"",
+            wire_args=wire,
+            num_returns=num_returns,
+            resources={},
+            owner_addr=self.address,
+            owner_worker_id=self.worker_id.binary(),
+            task_type=ts.TASK_ACTOR,
+            actor_id=ActorID(actor_id),
+            method_name=method_name,
+            caller_id=self.worker_id.binary(),
+        )
+        from ray_tpu.util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
+        return_refs = self._register_pending(spec, refs)
+        self._post_batched("actor", (actor_id, spec))
+        return return_refs
+
+    def _route_actor_spec(self, actor_id: bytes, spec: dict):
+        """Assign the per-actor sequence number and stage the spec for
+        pushing. Returns the submitter iff it needs a pump kick (runs on
+        the io loop, called from the batched drain)."""
+        sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
+        sub.seq += 1
+        spec["seq_no"] = sub.seq
+        if not sub.watched:
+            sub.watched = True
+            asyncio.ensure_future(self._watch_actor(actor_id))
+        if sub.state == "ALIVE" and sub.addr:
+            sub.push_queue.append(spec)
+            return sub
+        if sub.state == "DEAD":
+            self._fail_task(spec, ActorDiedError(actor_id, sub.death_cause or "actor is dead"))
+            return None
+        sub.buffer.append(spec)
+        if sub.state == "UNKNOWN":
+            asyncio.ensure_future(self._refresh_actor_state(sub))
+        return None
+
+    def _pump_actor(self, sub: _ActorSubmitter):
+        """Push staged specs as pipelined batch RPCs (reference:
+        actor_task_submitter.h pushes without waiting for prior replies;
+        the receiver's seq_no reorder buffer restores order). A shallow
+        queue ships single specs immediately; a burst coalesces into
+        PushActorTasks batches, which is what lifts small-call throughput —
+        the control plane is message-count-bound."""
+        if sub.state != "ALIVE" or not sub.addr:
+            return
+        max_batch = self._cfg_push_batch
+        while sub.push_queue and sub.pushing < self._cfg_actor_inflight:
+            batch = []
+            while sub.push_queue and len(batch) < max_batch:
+                batch.append(sub.push_queue.popleft())
+            sub.pushing += 1
+            asyncio.ensure_future(self._push_actor_batch(sub, batch))
+
+    async def _push_actor_batch(self, sub: _ActorSubmitter, batch: list):
+        # A restart resets sub.pushing to 0 and bumps the epoch; any stale
+        # decrement from this coroutine would drive it negative and void
+        # the in-flight cap, so every decrement checks the epoch it started
+        # under.
+        epoch0 = sub.epoch
+
+        def release_push_slot():
+            if sub.epoch == epoch0:
+                sub.pushing -= 1
+
+        for spec in batch:
+            sub.inflight[spec["task_id"]] = spec
+        try:
+            client = await self.pool.get(*sub.addr)
+        except (ConnectionLost, OSError):
+            # Connection never established: the tasks provably did not
+            # execute, so it is safe to buffer them for the restarted
+            # actor. Several pipelined batches can land here in any
+            # order — rebuild the buffer sorted by seq so the restarted
+            # executor's reorder window starts from the lowest seq.
+            release_push_slot()
+            for spec in batch:
+                sub.inflight.pop(spec["task_id"], None)
+            sub.buffer = deque(
+                sorted(
+                    list(batch) + list(sub.buffer),
+                    key=lambda s: s.get("seq_no", 0),
+                )
+            )
+            sub.state = "RESTARTING?"
+            asyncio.ensure_future(self._refresh_actor_state(sub))
+            return
+        for spec in batch:
+            self.task_events.record(spec, "SUBMITTED")
+        if len(batch) == 1:
+            # single-task fast path: reply rides the RPC response
+            spec = batch[0]
+            try:
+                reply = await client.call(
+                    "PushActorTask", {"spec": spec}, timeout=None
+                )
+            except (ConnectionLost, OSError):
+                # Actor worker died with this task dispatched. It may have
+                # already executed (e.g. it IS the task that killed the
+                # actor), so replaying after restart would double-execute —
+                # fail it instead, matching the reference's
+                # actor_task_submitter semantics (max_task_retries
+                # defaults to 0).
+                release_push_slot()
+                sub.inflight.pop(spec["task_id"], None)
+                sub.state = "RESTARTING?"
+                self._fail_task(
+                    spec,
+                    ActorDiedError(
+                        sub.actor_id, "actor died while this task was in flight"
+                    ),
+                )
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+                return
+            release_push_slot()
+            sub.inflight.pop(spec["task_id"], None)
+            await self._process_task_reply(spec, reply)
+            self._pump_actor(sub)
+            return
+        # Batched push: the receiver acks immediately and streams each
+        # task's reply back as it resolves (handle_ActorTaskReplies), so a
+        # slow task never holds a finished peer's reply. `pushing` stays
+        # held until every reply in the batch lands — that is the flow
+        # control bounding unreplied tasks per actor.
+        batch_state = {"remaining": len(batch), "sub": sub,
+                       "epoch": sub.epoch}
+        for spec in batch:
+            record = self._pending_tasks.get(spec["task_id"])
+            if record is not None:
+                record["push_batch"] = batch_state
+        try:
+            await client.call(
+                "PushActorTasks",
+                {"specs": batch, "reply_addr": list(self.address)},
+                timeout=None,
+            )
+        except (ConnectionLost, OSError):
+            sub.state = "RESTARTING?"
+            release_push_slot()
+            batch_state["epoch"] = -1  # stale: late replies must not double-count
+            for spec in batch:
+                sub.inflight.pop(spec["task_id"], None)
+                record = self._pending_tasks.get(spec["task_id"])
+                if record is not None:
+                    record.pop("push_batch", None)
+                self._fail_task(
+                    spec,
+                    ActorDiedError(
+                        sub.actor_id, "actor died while this task was in flight"
+                    ),
+                )
+            asyncio.ensure_future(self._refresh_actor_state(sub))
+
+    async def _refresh_actor_state(self, sub: _ActorSubmitter):
+        try:
+            info = await self.gcs_aio.call("GetActorInfo", {"actor_id": sub.actor_id})
+        except Exception:
+            return
+        if not info.get("found"):
+            return
+        await self._apply_actor_state(sub, info["actor"])
+
+    async def _apply_actor_state(self, sub: _ActorSubmitter, rec: dict):
+        state = rec["state"]
+        if state == "ALIVE" and rec.get("addr"):
+            new_addr = tuple(rec["addr"])
+            restarted = sub.addr is not None and new_addr != sub.addr
+            sub.addr = new_addr
+            sub.state = "ALIVE"
+            if restarted:
+                # seq keeps increasing; the fresh receiver reorders from the
+                # first seq it sees. Outstanding batch accounting belongs to
+                # the dead incarnation: invalidate it so late replies don't
+                # double-decrement.
+                sub.epoch += 1
+                sub.pushing = 0
+            if hasattr(sub, "creation_refs"):
+                del sub.creation_refs
+            if sub.buffer:
+                # Rebuffered (lower-seq) specs must precede anything staged
+                # while ALIVE: the fresh receiver's reorder window starts at
+                # the first seq it sees, so out-of-order delivery strands
+                # the lower seqs forever.
+                merged = sorted(
+                    list(sub.buffer) + list(sub.push_queue),
+                    key=lambda s: s.get("seq_no", 0),
+                )
+                sub.buffer.clear()
+                sub.push_queue = deque(merged)
+            self._pump_actor(sub)
+        elif state == "DEAD":
+            sub.state = "DEAD"
+            sub.death_cause = rec.get("death_cause", "")
+            sub.epoch += 1
+            sub.pushing = 0
+            err = ActorDiedError(sub.actor_id, f"actor died: {sub.death_cause}")
+            while sub.buffer:
+                self._fail_task(sub.buffer.popleft(), err)
+            while sub.push_queue:
+                self._fail_task(sub.push_queue.popleft(), err)
+            for spec in list(sub.inflight.values()):
+                record = self._pending_tasks.get(spec["task_id"])
+                if record is not None:
+                    record.pop("push_batch", None)
+                self._fail_task(spec, err)
+            sub.inflight.clear()
+        elif state in ("RESTARTING", "PENDING_CREATION"):
+            sub.state = state
+            sub.addr = None
+
+    @staticmethod
+    def _print_worker_log(msg: dict):
+        """Driver-side sink of the per-node log monitors (reference:
+        worker.py print_to_stdstream — '(pid=, ip=)'-prefixed relay)."""
+        import sys as _sys
+
+        stream = _sys.stderr if msg.get("is_err") else _sys.stdout
+        prefix = f"(pid={msg.get('pid')}, ip={msg.get('ip')})"
+        for line in msg.get("lines", []):
+            print(f"{prefix} {line}", file=stream)
+
+    def enable_log_to_driver(self):
+        """Stream worker stdout/stderr of this job to the driver."""
+        channel = f"logs:{self.job_id.binary().hex()}"
+        self._subscribed_channels.add(channel)
+        self.io.run(
+            self.gcs_aio.call(
+                "Subscribe",
+                {"sub_id": self.worker_id.binary(), "channel": channel},
+            )
+        )
+
+    async def _watch_actor(self, actor_id: bytes):
+        sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
+        channel = f"actor:{actor_id.hex()}"
+        self._subscribed_channels.add(channel)
+        await self.gcs_aio.call(
+            "Subscribe", {"sub_id": self.worker_id.binary(), "channel": channel}
+        )
+        await self._refresh_actor_state(sub)
+
+    async def _resubscribe_after_gcs_restart(self) -> bool:
+        """The GCS restarted (new epoch): its subscriber table is gone.
+
+        Re-subscribe every channel we were watching and re-read actor states
+        we may have missed while the GCS was down. Returns False if any
+        re-subscribe failed (a flapping GCS) so the caller keeps the old
+        epoch and retries on the next poll.
+        """
+        ok = True
+        for channel in list(self._subscribed_channels):
+            try:
+                await self.gcs_aio.call(
+                    "Subscribe",
+                    {"sub_id": self.worker_id.binary(), "channel": channel},
+                )
+            except Exception:
+                ok = False
+        for sub in list(self._actor_submitters.values()):
+            if sub.state != "DEAD":
+                asyncio.ensure_future(self._refresh_actor_state(sub))
+        return ok
+
+    async def _pubsub_loop(self):
+        """Single long-poll loop draining every GCS channel we subscribe to."""
+        epoch = None
+        while True:
+            try:
+                reply = await self.gcs_aio.call(
+                    "PubsubPoll",
+                    {"sub_id": self.worker_id.binary(), "timeout": 20.0},
+                    timeout=40.0,
+                )
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            new_epoch = reply.get("epoch")
+            if epoch is None or new_epoch == epoch:
+                epoch = new_epoch
+            elif await self._resubscribe_after_gcs_restart():
+                epoch = new_epoch
+            for channel, msg in reply.get("batch", []):
+                if channel.startswith("logs:"):
+                    self._print_worker_log(msg)
+                elif channel.startswith("actor:"):
+                    actor_id = msg["actor_id"]
+                    sub = self._actor_submitters.get(actor_id)
+                    if sub is not None:
+                        rec = {
+                            "state": msg["state"],
+                            "addr": msg.get("addr"),
+                            "death_cause": msg.get("death_cause", ""),
+                        }
+                        await self._apply_actor_state(sub, rec)
+
+    def kill_actor(self, actor_id: bytes, no_restart=True):
+        self.gcs.call("KillActor", {"actor_id": actor_id, "no_restart": no_restart})
+
+    def cancel_task(self, ref: ObjectRef, force=False, recursive=True):
+        async def go():
+            task_id = ref.object_id().task_id().binary()
+            record = self._pending_tasks.get(task_id)
+            if record is None:
+                return
+            lease = record.get("lease")
+            addr = None
+            if lease:
+                addr = lease["worker_addr"]
+            else:
+                spec = record["spec"]
+                if spec.get("actor_id"):
+                    sub = self._actor_submitters.get(spec["actor_id"])
+                    if sub and sub.addr:
+                        addr = sub.addr
+            if addr:
+                try:
+                    client = await self.pool.get(*addr)
+                    await client.notify("CancelTask", {"task_id": task_id})
+                except Exception:
+                    pass
+
+        self.io.run(go())
+
+    # ----------------------------------------------------- executor services
+
+    def on_became_actor(self, actor_id: bytes, spec: dict):
+        self.actor_id = actor_id
+        self._actor_spec = spec
+
+    def register_running_task(self, task_id: bytes, fut):
+        self._running_async[task_id] = fut
+
+    def unregister_running_task(self, task_id: bytes):
+        self._running_async.pop(task_id, None)
+
+    def try_cancel_running(self, task_id: bytes):
+        fut = self._running_async.get(task_id)
+        if fut is not None:
+            fut.cancel()
+
+    def push_task_context(self, spec: dict):
+        old = getattr(self._ctx, "spec", None)
+        self._ctx.spec = spec
+        return old
+
+    def pop_task_context(self, old):
+        self._ctx.spec = old
+
+    def current_task_spec(self):
+        return getattr(self._ctx, "spec", None)
+
+    async def put_return_to_plasma(self, oid: ObjectID, payload, spec) -> dict:
+        """Store a large task return into local plasma; owner is the caller."""
+        loop = asyncio.get_running_loop()
+        size = await loop.run_in_executor(
+            None, self._plasma_put_payload, oid, payload
+        )
+        try:
+            await self.raylet.call(
+                "PinObject",
+                {"object_id": oid.binary(), "owner_addr": list(spec["owner_addr"])},
+                timeout=30,
+            )
+        except Exception:
+            pass
+        return {"size": size, "node_id": self.node_id.binary()}
+
+    # -------------------------------------------------------------- handlers
+
+    async def handle_PushTask(self, req):
+        return await self.executor.execute_normal(req["spec"])
+
+    async def handle_PushTasks(self, req):
+        """Batched push: execute CONCURRENTLY (each task on its own thread),
+        reply in batch. Serial execution would deadlock tasks that
+        synchronize with each other (e.g. a barrier pair landing in one
+        batch); with one thread each they behave exactly as if they'd been
+        granted separate leases, which is the semantics batching must
+        preserve. The executor's persistent elastic pool supplies the
+        threads (creating a pool per RPC cost ~0.1 ms/thread)."""
+        specs = req["specs"]
+        pool = self.executor._batch_pool
+        # Preserve the old per-RPC-pool guarantee that every in-flight
+        # batched task owns a thread (tasks in a batch may synchronize with
+        # each other): grow the persistent pool's cap when concurrent
+        # batches would exhaust it. ThreadPoolExecutor only spawns threads
+        # on demand, so a high cap costs nothing until needed.
+        self.executor._batch_inflight += len(specs)
+        if self.executor._batch_inflight > pool._max_workers:
+            pool._max_workers = self.executor._batch_inflight + 16
+        try:
+            replies = await asyncio.gather(
+                *(self.executor._execute(spec, pool) for spec in specs)
+            )
+        finally:
+            self.executor._batch_inflight -= len(specs)
+        return {"replies": list(replies)}
+
+    async def handle_CreateActor(self, req):
+        return await self.executor.create_actor(req["spec"], req["actor_id"])
+
+    async def handle_PushActorTask(self, req):
+        return await self.executor.push_actor_task(req["spec"])
+
+    async def handle_PushActorTasks(self, req):
+        """Batched actor-task push: ack immediately, stream each task's
+        reply back to the owner as it resolves (batched notify frames).
+        One slow task in a batch never delays a finished peer's reply
+        (reference: per-call replies in core_worker.proto PushTask)."""
+        specs = req["specs"]
+        reply_addr = tuple(req["reply_addr"])
+        futs = self.executor.enqueue_actor_tasks(specs)
+        for spec, fut in zip(specs, futs):
+            task_id = spec["task_id"]
+            fut.add_done_callback(
+                lambda f, tid=task_id: self._queue_task_reply(
+                    reply_addr, tid, f
+                )
+            )
+        return {"accepted": len(specs)}
+
+    def _queue_task_reply(self, addr, task_id: bytes, fut):
+        """Buffer a resolved task reply for its owner; one in-flight flush
+        per destination burst (scheduled-drain, like _post_batched)."""
+        try:
+            reply = fut.result()
+        except Exception as e:  # executor-level failure
+            reply = {"status": "error", "error": str(e), "app_error": False}
+        buf = self._reply_bufs.setdefault(addr, [])
+        buf.append([task_id, reply])
+        if addr not in self._reply_flush_scheduled:
+            self._reply_flush_scheduled.add(addr)
+            asyncio.ensure_future(self._flush_task_replies(addr))
+
+    async def _flush_task_replies(self, addr):
+        try:
+            while True:
+                batch = self._reply_bufs.get(addr)
+                if not batch:
+                    return
+                self._reply_bufs[addr] = []
+                # A lost reply permanently hangs the owner's get() AND
+                # wedges its per-actor push window, so transient connect
+                # failures must retry; only an owner unreachable for ~15 s
+                # (presumed dead — nobody left to consume) drops them.
+                for attempt in range(6):
+                    try:
+                        client = await self.pool.get(addr[0], addr[1])
+                        await client.notify(
+                            "ActorTaskReplies", {"replies": batch}
+                        )
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.2 * (2 ** attempt))
+                else:
+                    self._reply_bufs.pop(addr, None)
+                    return
+        finally:
+            self._reply_flush_scheduled.discard(addr)
+
+    async def handle_ActorTaskReplies(self, req):
+        """Owner side: per-task replies streaming back from a batched
+        actor-task push."""
+        for task_id, reply in req["replies"]:
+            record = self._pending_tasks.get(task_id)
+            if record is None:
+                continue
+            spec = record["spec"]
+            batch_state = record.pop("push_batch", None)
+            await self._process_task_reply(spec, reply)
+            if batch_state is not None:
+                sub = batch_state["sub"]
+                sub.inflight.pop(task_id, None)
+                if batch_state["epoch"] == sub.epoch:
+                    batch_state["remaining"] -= 1
+                    if batch_state["remaining"] <= 0:
+                        sub.pushing -= 1
+                        self._pump_actor(sub)
+
+    async def handle_GetObjectStatus(self, req):
+        oid = ObjectID(req["object_id"])
+        if req.get("wait"):
+            timeout = min(req.get("timeout", 25.0), 25.0)
+            ready = await self.memory_store.wait_ready(oid, timeout)
+            if not ready:
+                return {"status": "pending"}
+        entry = self.memory_store.get_if_exists(oid)
+        if entry is None:
+            if self.memory_store.is_pending(oid):
+                return {"status": "pending"}
+            if self.refs.owns(oid):
+                return {"status": "pending"}
+            return {"status": "freed"}
+        if isinstance(entry, InPlasma):
+            return {
+                "status": "ready",
+                "plasma": {"size": entry.size, "locations": list(entry.locations)},
+            }
+        kind, payload = entry[0], entry[1]
+        if kind == _ERR:
+            return {"status": "ready", "err": payload}
+        return {"status": "ready", "inline": payload}
+
+    async def handle_AddBorrowerRef(self, req):
+        self.refs.add_borrower(ObjectID(req["object_id"]), tuple(req["borrower"]))
+
+    async def handle_RemoveBorrowerRef(self, req):
+        self.refs.remove_borrower(ObjectID(req["object_id"]), tuple(req["borrower"]))
+
+    async def handle_AddObjectLocation(self, req):
+        oid = ObjectID(req["object_id"])
+        self._object_locations.setdefault(oid.binary(), set()).add(req["node_id"])
+        entry = self.memory_store.get_if_exists(oid)
+        if isinstance(entry, InPlasma):
+            entry.locations.add(req["node_id"])
+
+    async def handle_RemoveObjectLocation(self, req):
+        oid = ObjectID(req["object_id"])
+        self._object_locations.get(oid.binary(), set()).discard(req["node_id"])
+        entry = self.memory_store.get_if_exists(oid)
+        if isinstance(entry, InPlasma):
+            entry.locations.discard(req["node_id"])
+
+    async def handle_Profile(self, req):
+        """On-demand stack sampling of THIS process (reference: dashboard
+        reporter profile_manager.py:78 py-spy; see _private/profiling.py)."""
+        from ray_tpu._private import profiling
+
+        loop = asyncio.get_running_loop()
+        counts = await loop.run_in_executor(
+            None, profiling.sample_stacks,
+            req.get("duration", 2.0), req.get("hz", 100.0),
+        )
+        return {"folded": profiling.folded_text(counts),
+                "samples": sum(counts.values()), "pid": os.getpid()}
+
+    async def handle_CancelTask(self, req):
+        self.executor.cancel(req["task_id"])
+
+    async def handle_KillActor(self, req):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+    async def handle_Exit(self, req):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return {"ok": True}
+
+    async def handle_Ping(self, req):
+        return {"ok": True, "worker_id": self.worker_id.binary()}
+
+    async def handle_GetCoreWorkerStats(self, req):
+        return {
+            "worker_id": self.worker_id.binary(),
+            "mode": self.mode,
+            "actor_id": self.actor_id,
+            "refs": self.refs.stats(),
+            "memory_store_size": self.memory_store.size(),
+            "pending_tasks": len(self._pending_tasks),
+        }
+
+    # ------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        if self.is_shutdown:
+            return
+        self.is_shutdown = True
+        set_worker_hooks(None)
+        try:
+            self.io.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        self.executor.shutdown()
+        try:
+            if self.plasma:
+                self.plasma.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------- globals
+
+global_worker: Optional[CoreWorker] = None
+
+
+def get_global_worker() -> CoreWorker:
+    if global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return global_worker
+
+
+def set_global_worker(worker: Optional[CoreWorker]):
+    global global_worker
+    global_worker = worker

@@ -1,0 +1,48 @@
+"""Worker process entrypoint, spawned by the raylet's WorkerPool.
+
+Counterpart of the reference's default_worker.py
+(reference: python/ray/_private/workers/default_worker.py, main loop
+worker.py:877). The process hosts a CoreWorker whose RPC server receives
+PushTask/CreateActor/PushActorTask; there is no polling loop — execution is
+entirely push-driven, so the main thread just parks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-host", required=True)
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--plasma-name", required=True)
+    parser.add_argument("--job-id", required=True)
+    parser.add_argument("--startup-token", type=int, required=True)
+    parser.add_argument("--session-dir", default="")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import MODE_WORKER, CoreWorker, set_global_worker
+
+    worker = CoreWorker(
+        mode=MODE_WORKER,
+        gcs_address=args.gcs_address,
+        raylet_addr=(args.raylet_host, args.raylet_port),
+        job_id=JobID.from_hex(args.job_id),
+        startup_token=args.startup_token,
+        session_dir=args.session_dir,
+        host=args.raylet_host,
+    )
+    set_global_worker(worker)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

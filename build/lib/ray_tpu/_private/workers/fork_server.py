@@ -1,0 +1,164 @@
+"""Fork server: preimports the runtime once, then forks worker processes.
+
+The reference hides worker-startup latency by prestarting pooled workers
+(reference: src/ray/raylet/worker_pool.h:359 PrestartWorkers). We go further:
+the raylet keeps one fork-server child per node that has already paid the
+Python import cost; each worker is an os.fork() of it (~tens of ms instead of
+~2 s of interpreter+import startup). The child process then builds its own
+CoreWorker and IO loop from scratch, so no event-loop/thread state crosses the
+fork — only module imports do.
+
+Protocol (line-delimited JSON):
+  stdin:  {"spawn": {"token": int, "job_id": hex, "env": {..}, "log_prefix": path}}
+          {"kill": pid}
+  stdout: {"ready": true}
+          {"spawned": token, "pid": pid}
+          {"dead": pid, "rc": int}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _reaper(out_lock):
+    while True:
+        try:
+            pid, status = os.waitpid(-1, 0)
+        except ChildProcessError:
+            # no children right now; wait for SIGCHLD via sleep
+            import time
+
+            time.sleep(0.2)
+            continue
+        except InterruptedError:
+            continue
+        rc = os.waitstatus_to_exitcode(status)
+        with out_lock:
+            print(json.dumps({"dead": pid, "rc": rc}), flush=True)
+
+
+def _child_main(args, spawn):
+    os.setsid()
+    for k, v in (spawn.get("env") or {}).items():
+        os.environ[k] = str(v)
+    # runtime_env working_dir: run user code from the materialized directory
+    # with it importable (reference: runtime_env working_dir semantics —
+    # cwd + sys.path entry).
+    wd = os.environ.get("RTPU_WORKING_DIR")
+    if wd:
+        try:
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+        except OSError:
+            print(f"runtime_env: cannot enter working_dir {wd!r}", file=sys.stderr)
+    # runtime_env pip venvs + py_modules: the raylet materialized them and
+    # hands their import roots here; forked workers adopt them by sys.path
+    # (the venv shares this interpreter via --system-site-packages, so
+    # path adoption IS "running inside the venv" for import purposes).
+    pypath = os.environ.get("RTPU_PYPATH_PREPEND")
+    if pypath:
+        import importlib
+
+        for p in reversed(pypath.split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
+        importlib.invalidate_caches()
+    # If jax was preimported (by us or a plugin), its platform config may
+    # have been baked at import time — some platform plugins even force
+    # their own value, ignoring the env. Re-sync from the (inherited +
+    # overridden) environment before any backend initializes, so workers
+    # honor JAX_PLATFORMS/XLA_FLAGS exactly like a fresh process would.
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_platforms", os.environ.get("JAX_PLATFORMS") or None
+            )
+        except Exception:
+            pass
+    log_prefix = spawn.get("log_prefix", "")
+    if log_prefix:
+        out = open(log_prefix + ".out", "ab", buffering=0)
+        err = open(log_prefix + ".err", "ab", buffering=0)
+        os.dup2(out.fileno(), 1)
+        os.dup2(err.fileno(), 2)
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import MODE_WORKER, CoreWorker, set_global_worker
+
+    worker = CoreWorker(
+        mode=MODE_WORKER,
+        gcs_address=args.gcs_address,
+        raylet_addr=(args.raylet_host, args.raylet_port),
+        job_id=JobID.from_hex(spawn["job_id"]),
+        startup_token=spawn["token"],
+        session_dir=args.session_dir,
+        host=args.raylet_host,
+    )
+    set_global_worker(worker)
+    threading.Event().wait()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-host", required=True)
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", default="")
+    args = parser.parse_args(argv)
+
+    # Pay the import bill once, before any fork.
+    import numpy  # noqa: F401
+
+    import ray_tpu._private.executor  # noqa: F401
+    import ray_tpu._private.worker  # noqa: F401
+
+    out_lock = threading.Lock()
+    signal.signal(signal.SIGCHLD, signal.SIG_DFL)
+    threading.Thread(target=_reaper, args=(out_lock,), daemon=True).start()
+    with out_lock:
+        print(json.dumps({"ready": True}), flush=True)
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "spawn" in req:
+            spawn = req["spawn"]
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    _child_main(args, spawn)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                finally:
+                    os._exit(1)
+            with out_lock:
+                print(json.dumps({"spawned": spawn["token"], "pid": pid}), flush=True)
+        elif "kill" in req:
+            try:
+                os.killpg(os.getpgid(req["kill"]), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(req["kill"], signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+
+if __name__ == "__main__":
+    main()

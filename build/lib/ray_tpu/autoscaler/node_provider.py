@@ -1,0 +1,109 @@
+"""Node providers: the pluggable "launch me a node" backend.
+
+Counterpart of the reference's NodeProvider plugin API
+(reference: python/ray/autoscaler/node_provider.py:13) and the fake
+multi-node provider used for cloud-free autoscaler e2e tests
+(reference: autoscaler/_private/fake_multi_node/node_provider.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract: launch/terminate/list, by node type."""
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider_node_id -> node_type"""
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches REAL raylet processes on this machine, one per 'node'
+    (reference: fake_multi_node/node_provider.py — autoscaler e2e without a
+    cloud). Each created node joins the target cluster's GCS with the node
+    type's resources/labels.
+    """
+
+    def __init__(self, gcs_address: str, node_types: Dict[str, dict], session_dir: str = ""):
+        self.gcs_address = gcs_address
+        self.node_types = node_types
+        self.session_dir = session_dir
+        self._nodes: Dict[str, dict] = {}  # provider id -> {"node": Node, "type": str}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        from ray_tpu._private.node import Node
+
+        cfg = self.node_types[node_type]
+        created = []
+        for _ in range(count):
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:6]}"
+            node = Node(
+                head=False,
+                gcs_address=self.gcs_address,
+                resources=dict(cfg.get("resources", {})),
+                labels={**cfg.get("labels", {}), "node_type": node_type},
+                session_dir=self.session_dir or None,
+                node_name=pid,
+            )
+            with self._lock:
+                self._nodes[pid] = {"node": node, "type": node_type}
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            rec = self._nodes.pop(provider_node_id, None)
+        if rec is not None:
+            rec["node"].shutdown()
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return {pid: rec["type"] for pid, rec in self._nodes.items()}
+
+    def raylet_node_id(self, provider_node_id: str) -> Optional[bytes]:
+        with self._lock:
+            rec = self._nodes.get(provider_node_id)
+        return rec["node"].node_id.binary() if rec else None
+
+    def shutdown(self):
+        with self._lock:
+            nodes, self._nodes = list(self._nodes.values()), {}
+        for rec in nodes:
+            rec["node"].shutdown()
+
+
+class RecordingNodeProvider(NodeProvider):
+    """Test double that only records launch/terminate calls."""
+
+    def __init__(self, node_types: Optional[Dict[str, dict]] = None):
+        self.node_types = node_types or {}
+        self.launches: List[str] = []
+        self.terminations: List[str] = []
+        self._nodes: Dict[str, str] = {}
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        out = []
+        for _ in range(count):
+            pid = f"rec-{node_type}-{len(self.launches)}"
+            self.launches.append(node_type)
+            self._nodes[pid] = node_type
+            out.append(pid)
+        return out
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self.terminations.append(provider_node_id)
+        self._nodes.pop(provider_node_id, None)
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        return dict(self._nodes)

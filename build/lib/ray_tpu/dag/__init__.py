@@ -1,0 +1,8 @@
+from ray_tpu.dag.node import (  # noqa: F401
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef  # noqa: F401

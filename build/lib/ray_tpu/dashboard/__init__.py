@@ -1,0 +1,5 @@
+"""ray_tpu.dashboard — HTTP surface over the cluster's state + jobs."""
+
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

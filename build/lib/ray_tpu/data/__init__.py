@@ -1,0 +1,170 @@
+"""ray_tpu.data — streaming datasets over the distributed object store.
+
+Reference: python/ray/data (the streaming-executor subset per SURVEY.md §2.3:
+read/from_items → map_batches → iter_batches with operator pools and
+backpressure). Blocks are plasma objects; map stages are task/actor pools;
+iteration overlaps ingest with downstream compute.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block  # noqa: F401
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_BLOCK_ROWS = 1024
+
+
+def from_items(items: List[Any], *, override_num_blocks: Optional[int] = None
+               ) -> Dataset:
+    """Create a dataset from a python list (reference: data.from_items)."""
+    from ray_tpu.data._streaming import _rows_to_block
+
+    n = len(items)
+    if n == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, n // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (n + nblocks - 1) // nblocks)
+    refs = []
+    for i in builtins.range(0, n, per):
+        chunk = list(items[i:i + per])
+        refs.append(ray_tpu.put(_rows_to_block(chunk)))
+    return Dataset(refs)
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    if n == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, n // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (n + nblocks - 1) // nblocks)
+    refs = [
+        ray_tpu.put({"id": np.arange(i, min(n, i + per), dtype=np.int64)})
+        for i in builtins.range(0, n, per)
+    ]
+    return Dataset(refs)
+
+
+def from_numpy(arr, column: str = "data",
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    arr = np.asarray(arr)
+    if len(arr) == 0:
+        return Dataset([])
+    nblocks = override_num_blocks or max(1, min(32, len(arr) // DEFAULT_BLOCK_ROWS or 1))
+    per = max(1, (len(arr) + nblocks - 1) // nblocks)
+    refs = [
+        ray_tpu.put({column: arr[i:i + per]})
+        for i in builtins.range(0, len(arr), per)
+    ]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_parquet_task(path: str, columns):
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """One block per parquet file, read in parallel by tasks
+    (reference: data.read_parquet / datasource/parquet_datasource)."""
+    refs = [
+        _read_parquet_task.remote(f, columns)
+        for f in _expand_files(paths, ".parquet")
+    ]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_csv_task(path: str):
+    import pyarrow.csv as pcsv
+
+    table = pcsv.read_csv(path)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_csv(paths) -> Dataset:
+    refs = [_read_csv_task.remote(f) for f in _expand_files(paths, ".csv")]
+    return Dataset(refs)
+
+
+def _expand_files(paths, suffix: str) -> List[str]:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, f"*{suffix}"))))
+        else:
+            files.extend(sorted(glob.glob(p)) or [p])
+    if not files:
+        raise FileNotFoundError(f"no {suffix} files under {paths}")
+    return files
+
+
+@ray_tpu.remote
+def _read_json_task(path: str):
+    import pyarrow.json as pjson
+
+    table = pjson.read_json(path)
+    return {
+        name: table.column(name).to_numpy(zero_copy_only=False)
+        for name in table.column_names
+    }
+
+
+def read_json(paths) -> Dataset:
+    """Newline-delimited JSON, one block per file
+    (reference: data.read_json / datasource/json_datasource)."""
+    refs = [_read_json_task.remote(f) for f in _expand_files(paths, ".json")]
+    return Dataset(refs)
+
+
+@ray_tpu.remote
+def _read_text_task(path: str):
+    with open(path) as f:
+        return {"text": np.asarray([ln.rstrip("\n") for ln in f], dtype=object)}
+
+
+def read_text(paths) -> Dataset:
+    """One row per line (reference: data.read_text)."""
+    refs = [_read_text_task.remote(f) for f in _expand_files(paths, ".txt")]
+    return Dataset(refs)
+
+
+def from_pandas(dfs) -> Dataset:
+    """One block per DataFrame (reference: data.from_pandas)."""
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    refs = [
+        ray_tpu.put({c: df[c].to_numpy() for c in df.columns}) for df in dfs
+    ]
+    return Dataset(refs)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    refs = [
+        ray_tpu.put({
+            name: t.column(name).to_numpy(zero_copy_only=False)
+            for name in t.column_names
+        })
+        for t in tables
+    ]
+    return Dataset(refs)

@@ -1,0 +1,217 @@
+"""Streaming executor: pull-based operator pipeline with bounded in-flight
+work per stage (reference: data/_internal/execution/streaming_executor.py:48,
+physical_operator.py:139, backpressure_policy/).
+
+Each map stage keeps at most ``max_in_flight`` block tasks outstanding and
+yields output refs as they complete, pulling from its upstream lazily — so a
+downstream consumer (e.g. a training loop) overlaps ingest with compute and
+memory stays bounded at stage_depth x block_size instead of dataset_size.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, block_num_rows, concat_blocks, slice_block
+
+logger = logging.getLogger("ray_tpu.data")
+
+DEFAULT_MAX_IN_FLIGHT = 8
+
+
+@ray_tpu.remote
+def _map_block_task(fn_payload, block, *, is_batch_fn: bool):
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_payload)
+    return _apply(fn, block, is_batch_fn)
+
+
+def _apply(fn, block: Block, is_batch_fn: bool) -> Block:
+    from ray_tpu.data.block import rows_of
+
+    if is_batch_fn:
+        return fn(block)
+    out = [fn(r) for r in rows_of(block)]
+    return _rows_to_block(out)
+
+
+def _rows_to_block(rows: List[Any]) -> Block:
+    import numpy as np
+
+    if rows and isinstance(rows[0], dict) and all(
+        isinstance(r, dict) for r in rows
+    ):
+        keys = rows[0].keys()
+        if all(r.keys() == keys for r in rows):
+            try:
+                return {k: np.asarray([r[k] for r in rows]) for k in keys}
+            except Exception:
+                return rows
+    return rows
+
+
+class MapOperator:
+    """One logical map_batches/map/filter stage."""
+
+    def __init__(self, fn: Callable, *, is_batch_fn: bool,
+                 compute_actors: int = 0, fn_constructor_args: tuple = (),
+                 num_cpus: float = 1.0,
+                 max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+                 name: str = "Map"):
+        self.fn = fn
+        self.is_batch_fn = is_batch_fn
+        self.compute_actors = compute_actors
+        self.fn_constructor_args = fn_constructor_args
+        self.num_cpus = num_cpus
+        self.max_in_flight = max_in_flight
+        self.name = name
+
+    # ------------------------------------------------------------- execution
+
+    def stream(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        if self.compute_actors:
+            yield from self._stream_actors(upstream)
+        else:
+            yield from self._stream_tasks(upstream)
+
+    def _stream_tasks(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        import collections
+
+        import cloudpickle
+
+        payload = cloudpickle.dumps(self.fn)
+        # Yield in INPUT order (completion order would make block order — and
+        # therefore take()/iter_batches contents — nondeterministic): block
+        # on the oldest outstanding task whenever the window is full.
+        in_flight: "collections.deque" = collections.deque()
+        task = _map_block_task.options(num_cpus=self.num_cpus)
+        for ref in upstream:
+            in_flight.append(
+                task.remote(payload, ref, is_batch_fn=self.is_batch_fn)
+            )
+            while len(in_flight) >= self.max_in_flight:
+                yield in_flight.popleft()
+        while in_flight:
+            yield in_flight.popleft()
+
+    def _stream_actors(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        """Class-based UDF on a pool of actors (reference: ActorPoolStrategy).
+        The callable is constructed once per actor and reused per block."""
+
+        @ray_tpu.remote
+        class _MapWorker:
+            def __init__(self, fn_payload, ctor_args):
+                import cloudpickle
+
+                cls = cloudpickle.loads(fn_payload)
+                self.callable = cls(*ctor_args)
+
+            def apply(self, block, is_batch_fn):
+                return _apply(self.callable, block, is_batch_fn)
+
+        import cloudpickle
+
+        payload = cloudpickle.dumps(self.fn)
+        pool = [
+            _MapWorker.options(num_cpus=self.num_cpus).remote(
+                payload, self.fn_constructor_args
+            )
+            for _ in range(self.compute_actors)
+        ]
+        import collections
+
+        per_actor_cap = max(2, self.max_in_flight // len(pool))
+        in_flight: "collections.deque" = collections.deque()  # (ref, idx)
+        load = [0] * len(pool)
+        try:
+            for ref in upstream:
+                while sum(load) >= per_actor_cap * len(pool):
+                    done_ref, done_idx = in_flight.popleft()
+                    load[done_idx] -= 1
+                    yield done_ref  # input order preserved
+                idx = min(range(len(pool)), key=lambda i: load[i])
+                out = pool[idx].apply.remote(ref, self.is_batch_fn)
+                in_flight.append((out, idx))
+                load[idx] += 1
+            while in_flight:
+                done_ref, done_idx = in_flight.popleft()
+                load[done_idx] -= 1
+                yield done_ref
+        finally:
+            for a in pool:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+
+def rechunk_blocks(blocks: Iterator[Block], rows: int) -> Iterator[Block]:
+    """Re-chunk a stream of blocks to exactly `rows` per block (short tail),
+    with bounded memory: the current accumulation plus one upstream block."""
+    pending: Optional[Block] = None
+    for block in blocks:
+        if pending is not None:
+            block = concat_blocks([pending, block])
+            pending = None
+        n = block_num_rows(block)
+        off = 0
+        while n - off >= rows:
+            yield slice_block(block, off, off + rows)
+            off += rows
+        if off < n:
+            pending = slice_block(block, off, n)
+    if pending is not None and block_num_rows(pending):
+        yield pending
+
+
+class RechunkOperator:
+    """Lazy in-stream re-chunking to a fixed rows-per-block. Used by
+    map_batches(batch_size=N) so the plan is never executed twice."""
+
+    def __init__(self, rows_per_block: int):
+        self.rows = rows_per_block
+        self.name = f"Rechunk({rows_per_block})"
+
+    def stream(self, upstream: Iterator[Any]) -> Iterator[Any]:
+        blocks = (ray_tpu.get(r) for r in upstream)
+        for out in rechunk_blocks(blocks, self.rows):
+            yield ray_tpu.put(out)
+
+
+def execute_plan(source_refs: List[Any],
+                 operators: List[MapOperator]) -> Iterator[Any]:
+    """Chain the stages into one lazy pull pipeline of block refs."""
+    stream: Iterator[Any] = iter(source_refs)
+    for op in operators:
+        stream = op.stream(stream)
+    return stream
+
+
+def iter_batches_from_stream(
+    ref_stream: Iterator[Any],
+    batch_size: Optional[int],
+    prefetch_blocks: int = 2,
+) -> Iterator[Block]:
+    """Materialize blocks with bounded prefetch and re-chunk to batch_size."""
+    import collections
+
+    window: "collections.deque" = collections.deque()
+
+    def blocks():
+        while True:
+            while len(window) < max(1, prefetch_blocks):
+                try:
+                    window.append(next(ref_stream))
+                except StopIteration:
+                    break
+            if not window:
+                return
+            yield ray_tpu.get(window.popleft())
+
+    if batch_size is None:
+        yield from blocks()
+        return
+    yield from rechunk_blocks(blocks(), batch_size)

@@ -1,0 +1,526 @@
+"""Dataset: lazy logical plan over object-store blocks
+(reference: python/ray/data/dataset.py:139 — the streaming subset).
+
+A Dataset is (source block refs, chain of map operators). Transformations
+append operators; consumption (iter_batches/take/count/materialize) runs the
+streaming executor. Blocks live in plasma; workers read them zero-copy.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._streaming import (
+    DEFAULT_MAX_IN_FLIGHT,
+    MapOperator,
+    execute_plan,
+    iter_batches_from_stream,
+)
+from ray_tpu.data.block import (
+    Block,
+    block_num_rows,
+    block_schema,
+    concat_blocks,
+    rows_of,
+    slice_block,
+)
+
+logger = logging.getLogger("ray_tpu.data")
+
+
+class Dataset:
+    def __init__(self, source_refs: List[Any],
+                 operators: Optional[List[MapOperator]] = None,
+                 extra_legs: Optional[List["Dataset"]] = None):
+        self._source_refs = list(source_refs)
+        self._operators = list(operators or [])
+        # union() legs: independent (refs, ops) plans appended lazily
+        self._extra_legs: List["Dataset"] = list(extra_legs or [])
+
+    # ---------------------------------------------------------- transforms
+
+    def _with_op(self, op) -> "Dataset":
+        return Dataset(
+            self._source_refs, self._operators + [op],
+            [leg._with_op(op) for leg in self._extra_legs],
+        )
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        concurrency: Optional[int] = None,
+        fn_constructor_args: tuple = (),
+        num_cpus: float = 1.0,
+        max_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+    ) -> "Dataset":
+        """Apply fn to whole blocks (reference: Dataset.map_batches). A class
+        fn runs on an actor pool of `concurrency` actors; a plain function
+        runs as tasks. batch_size=None maps entire blocks (recommended — the
+        executor already sizes blocks)."""
+        is_class = isinstance(fn, type)
+        op = MapOperator(
+            fn,
+            is_batch_fn=True,
+            compute_actors=(concurrency or 2) if is_class else 0,
+            fn_constructor_args=fn_constructor_args,
+            num_cpus=num_cpus,
+            max_in_flight=(concurrency or max_in_flight)
+            if not is_class else max_in_flight,
+            name=getattr(fn, "__name__", "MapBatches"),
+        )
+        ds = self
+        if batch_size is not None:
+            from ray_tpu.data._streaming import RechunkOperator
+
+            ds = ds._with_op(RechunkOperator(batch_size))
+        return ds._with_op(op)
+
+    def map(self, fn: Callable, *, num_cpus: float = 1.0,
+            max_in_flight: int = DEFAULT_MAX_IN_FLIGHT) -> "Dataset":
+        return self._with_op(MapOperator(
+            fn, is_batch_fn=False, num_cpus=num_cpus,
+            max_in_flight=max_in_flight, name="Map",
+        ))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def batch_fn(block):
+            out = []
+            for row in rows_of(block):
+                out.extend(fn(row))
+            from ray_tpu.data._streaming import _rows_to_block
+
+            return _rows_to_block(out)
+
+        return self._with_op(
+            MapOperator(batch_fn, is_batch_fn=True, name="FlatMap")
+        )
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def batch_fn(block):
+            if isinstance(block, dict):
+                keep = [i for i, row in enumerate(rows_of(block)) if fn(row)]
+                return {k: np.asarray(v)[keep] for k, v in block.items()}
+            return [r for r in block if fn(r)]
+
+        return self._with_op(
+            MapOperator(batch_fn, is_batch_fn=True, name="Filter")
+        )
+
+    # --------------------------------------------------------- re-chunking
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Materializing re-chunk into num_blocks equal-ish blocks."""
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        per = max(1, (n + num_blocks - 1) // num_blocks)
+        refs = [
+            ray_tpu.put(slice_block(whole, i * per, min(n, (i + 1) * per)))
+            for i in range(min(num_blocks, (n + per - 1) // per))
+        ]
+        return Dataset(refs)
+
+    def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
+        return self.repartition(
+            max(1, (self.count() + rows_per_block - 1) // rows_per_block)
+        )
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        """Materializing full shuffle (block concat + permutation)."""
+        rng = np.random.default_rng(seed)
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        n = block_num_rows(whole)
+        perm = rng.permutation(n)
+        if isinstance(whole, dict):
+            shuffled: Block = {k: np.asarray(v)[perm] for k, v in whole.items()}
+        else:
+            shuffled = [whole[i] for i in perm]
+        nblocks = max(1, len(self._source_refs))
+        per = max(1, (n + nblocks - 1) // nblocks)
+        refs = [
+            ray_tpu.put(slice_block(shuffled, i * per, min(n, (i + 1) * per)))
+            for i in range((n + per - 1) // per)
+        ]
+        return Dataset(refs)
+
+    def split(self, n: int, equal: bool = True) -> List["Dataset"]:
+        """Materializing row-exact split (reference: Dataset.split).
+        equal=True gives identical shard sizes, dropping up to n-1 trailing
+        rows (like the reference); raises if shards would be empty.
+        equal=False balances floor/ceil sizes with no rows dropped."""
+        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
+        whole = concat_blocks(blocks)
+        total = block_num_rows(whole)
+        if equal:
+            per = total // n
+            if per == 0:
+                raise ValueError(
+                    f"cannot split {total} rows into {n} equal non-empty "
+                    "shards"
+                )
+            sizes = [per] * n
+        else:
+            base, rem = divmod(total, n)
+            sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        out, start = [], 0
+        for size in sizes:
+            out.append(
+                Dataset([ray_tpu.put(slice_block(whole, start, start + size))])
+            )
+            start += size
+        return out
+
+    def split_blocks(self, n: int) -> List["Dataset"]:
+        """Lazy block-granular split: shard i keeps source blocks i::n and
+        the SAME pending operator chain, so per-shard streaming (and
+        ingest/compute overlap) is preserved. Row counts are equal only up
+        to block granularity — the Train ingest path uses this (reference:
+        streaming_split keeps sharding lazy the same way)."""
+        leg_shards = [leg.split_blocks(n) for leg in self._extra_legs]
+        shards: List[Dataset] = []
+        for i in range(n):
+            shard = Dataset(self._source_refs[i::n], self._operators)
+            for per_leg in leg_shards:
+                shard = shard.union(per_leg[i])
+            shards.append(shard)
+        return shards
+
+    def union(self, other: "Dataset") -> "Dataset":
+        """Lazy concatenation: both plans stay pending until consumption."""
+        return Dataset(
+            self._source_refs, self._operators,
+            self._extra_legs + [other],
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        """Materializing head-n (reference: Dataset.limit)."""
+        rows = self.take(n)
+        from ray_tpu.data._streaming import _rows_to_block
+
+        return Dataset([ray_tpu.put(_rows_to_block(rows))] if rows else [])
+
+    def sort(self, key: Union[str, Callable, None] = None,
+             descending: bool = False) -> "Dataset":
+        """Distributed sample-sort (reference: Dataset.sort via
+        data/_internal/planner/exchange/sort_task_spec.py): sample keys →
+        range-partition map tasks → per-partition sort-merge tasks. The
+        driver handles only key samples and boundary values, so datasets
+        larger than driver memory sort fine."""
+        from ray_tpu.data._exchange import distributed_sort
+
+        refs = list(self._iter_block_refs())
+        return Dataset(distributed_sort(refs, key, descending))
+
+    def unique(self, column: str) -> List[Any]:
+        vals = set()
+        for block in self.iter_batches(batch_size=None):
+            if isinstance(block, dict):
+                vals.update(np.asarray(block[column]).tolist())
+            else:
+                vals.update(r[column] for r in block)
+        return sorted(vals)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Materializing columnar zip of equal-length datasets
+        (reference: Dataset.zip)."""
+        a = concat_blocks([ray_tpu.get(r) for r in self._iter_block_refs()])
+        b = concat_blocks([ray_tpu.get(r) for r in other._iter_block_refs()])
+        if block_num_rows(a) != block_num_rows(b):
+            raise ValueError("zip requires equal row counts")
+        if block_num_rows(a) == 0:
+            return Dataset([])
+        if not (isinstance(a, dict) and isinstance(b, dict)):
+            raise TypeError("zip requires column blocks")
+        merged = dict(a)
+        for k, v in b.items():
+            merged[k if k not in merged else f"{k}_1"] = v
+        return Dataset([ray_tpu.put(merged)])
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------------------------------------------- simple aggregates
+
+    def _column(self, column: str) -> np.ndarray:
+        parts = [
+            np.asarray(b[column])
+            for b in self.iter_batches(batch_size=None)
+            if block_num_rows(b)
+        ]
+        return np.concatenate(parts) if parts else np.array([])
+
+    def sum(self, column: str):
+        return self._column(column).sum().item()
+
+    def mean(self, column: str):
+        return self._column(column).mean().item()
+
+    def min(self, column: str):
+        return self._column(column).min().item()
+
+    def max(self, column: str):
+        return self._column(column).max().item()
+
+    def std(self, column: str, ddof: int = 1):
+        return self._column(column).std(ddof=ddof).item()
+
+    # -------------------------------------------------------------- writes
+
+    def _column_blocks(self):
+        for i, ref in enumerate(self._iter_block_refs()):
+            block = ray_tpu.get(ref)
+            if not isinstance(block, dict):
+                from ray_tpu.data._streaming import _rows_to_block
+
+                block = _rows_to_block(list(rows_of(block)))
+                if not isinstance(block, dict):
+                    block = {"value": np.asarray(block, dtype=object)}
+            yield i, block
+
+    def write_parquet(self, path: str) -> List[str]:
+        """One file per block under `path`
+        (reference: Dataset.write_parquet)."""
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in self._column_blocks():
+            fp = os.path.join(path, f"part-{i:05d}.parquet")
+            pq.write_table(pa.table(dict(block)), fp)
+            out.append(fp)
+        return out
+
+    def write_csv(self, path: str) -> List[str]:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.csv as pcsv
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, block in self._column_blocks():
+            fp = os.path.join(path, f"part-{i:05d}.csv")
+            pcsv.write_csv(pa.table(dict(block)), fp)
+            out.append(fp)
+        return out
+
+    def write_json(self, path: str) -> List[str]:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        out = []
+        for i, ref in enumerate(self._iter_block_refs()):
+            fp = os.path.join(path, f"part-{i:05d}.json")
+            with open(fp, "w") as f:
+                for row in rows_of(ray_tpu.get(ref)):
+                    if isinstance(row, dict):
+                        row = {
+                            k: v.item() if isinstance(v, np.generic) else v
+                            for k, v in row.items()
+                        }
+                    f.write(json.dumps(row) + "\n")
+            out.append(fp)
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        whole = concat_blocks(
+            [ray_tpu.get(r) for r in self._iter_block_refs()]
+        )
+        if isinstance(whole, dict):
+            return pd.DataFrame(dict(whole))
+        return pd.DataFrame({"value": list(whole)})
+
+    # ---------------------------------------------------------- consumption
+
+    def _iter_block_refs(self) -> Iterator[Any]:
+        import itertools
+
+        return itertools.chain(
+            execute_plan(self._source_refs, self._operators),
+            *(leg._iter_block_refs() for leg in self._extra_legs),
+        )
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     prefetch_blocks: int = 2) -> Iterator[Block]:
+        """Streaming iteration: upstream map stages keep working while the
+        consumer processes the current batch (ingest/compute overlap)."""
+        return iter_batches_from_stream(
+            self._iter_block_refs(), batch_size, prefetch_blocks
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_batches(batch_size=None):
+            yield from rows_of(block)
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None, dtypes=None, drop_last: bool = False,
+                         prefetch_blocks: int = 2) -> Iterator[Dict[str, Any]]:
+        """iter_batches with each column placed on device as a jax array
+        (reference: iterator.iter_torch_batches — the jax-first analogue).
+        `sharding` is an optional jax.sharding.Sharding (e.g. a batch
+        NamedSharding over a mesh's dp axis) applied by device_put; ingest
+        of the NEXT batch overlaps with the caller's step on the current
+        one via the streaming executor."""
+        import jax
+        import jax.numpy as jnp
+
+        n_shards = 1
+        if sharding is not None:
+            n_shards = getattr(sharding, "num_devices", None) or len(
+                getattr(sharding, "device_set", [1]))
+        for block in self.iter_batches(batch_size=batch_size,
+                                       prefetch_blocks=prefetch_blocks):
+            if not isinstance(block, dict):
+                raise TypeError("iter_jax_batches requires column blocks")
+            rows = block_num_rows(block)
+            if sharding is not None and rows % n_shards:
+                # a partial final batch can't be laid out on the mesh axis
+                if drop_last:
+                    continue
+                raise ValueError(
+                    f"final batch of {rows} rows is not divisible by the "
+                    f"{n_shards}-way sharding; pass drop_last=True (or a "
+                    "batch_size divisible by the mesh axis)"
+                )
+            out = {}
+            for k, v in block.items():
+                arr = np.asarray(v)
+                if dtypes and k in dtypes:
+                    arr = arr.astype(dtypes[k])
+                out[k] = (jax.device_put(arr, sharding)
+                          if sharding is not None else jnp.asarray(arr))
+            yield out
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, prefetch_blocks: int = 2
+                           ) -> Iterator[Dict[str, Any]]:
+        """iter_batches as dicts of torch tensors
+        (reference: data/iterator.py iter_torch_batches)."""
+        import torch
+
+        for block in self.iter_batches(batch_size=batch_size,
+                                       prefetch_blocks=prefetch_blocks):
+            if not isinstance(block, dict):
+                raise TypeError("iter_torch_batches requires column blocks")
+            out = {}
+            for k, v in block.items():
+                arr = np.ascontiguousarray(v)
+                t = torch.from_numpy(arr)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
+    def streaming_split(self, n: int) -> List["Dataset"]:
+        """Reference-named alias of split_blocks: n lazy shards that keep
+        streaming through the pending operator chain (reference:
+        Dataset.streaming_split — Train ingest path)."""
+        return self.split_blocks(n)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        if not self._operators and not self._extra_legs:
+            if not self._source_refs:
+                return 0
+            return sum(
+                block_num_rows(b)
+                for b in ray_tpu.get(list(self._source_refs))
+            )
+        return sum(
+            block_num_rows(b) for b in self.iter_batches(batch_size=None)
+        )
+
+    def schema(self):
+        for r in self._iter_block_refs():
+            return block_schema(ray_tpu.get(r))
+        return None
+
+    def materialize(self) -> "Dataset":
+        """Run the plan now; the result holds only materialized blocks."""
+        return Dataset(list(self._iter_block_refs()))
+
+    def num_blocks(self) -> int:
+        return len(self._source_refs) + sum(
+            leg.num_blocks() for leg in self._extra_legs
+        )
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._operators) or "source"
+        return (f"Dataset(num_blocks={len(self._source_refs)}, "
+                f"plan={ops})")
+
+
+class GroupedData:
+    """Group aggregation over the distributed sample-sort exchange
+    (reference: python/ray/data/grouped_data.py over
+    exchange/sort_task_spec.py): range-partitioning by the group key puts
+    every row of a key into exactly one partition, so per-partition
+    aggregation tasks are exact and nothing materializes on the driver."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, column: Optional[str], how: str) -> Dataset:
+        from ray_tpu.data._exchange import distributed_group_agg
+
+        refs = list(self._ds._iter_block_refs())
+        if not refs:
+            name = f"{how}({column})" if column else f"{how}()"
+            return Dataset([ray_tpu.put({
+                self._key: np.array([]), name: np.array([]),
+            })])
+        return Dataset(
+            distributed_group_agg(refs, self._key, column, how)
+        )
+
+    def count(self) -> Dataset:
+        return self._agg(None, "count")
+
+    def sum(self, column: str) -> Dataset:
+        return self._agg(column, "sum")
+
+    def mean(self, column: str) -> Dataset:
+        return self._agg(column, "mean")
+
+    def min(self, column: str) -> Dataset:
+        return self._agg(column, "min")
+
+    def max(self, column: str) -> Dataset:
+        return self._agg(column, "max")
+
+    def std(self, column: str) -> Dataset:
+        return self._agg(column, "std")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn to each group's sub-block; concat per partition
+        (groups never split across partitions)."""
+        from ray_tpu.data._exchange import distributed_group_map
+
+        refs = list(self._ds._iter_block_refs())
+        if not refs:
+            return Dataset([])
+        return Dataset(distributed_group_map(refs, self._key, fn))

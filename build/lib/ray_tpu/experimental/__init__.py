@@ -1,0 +1,1 @@
+"""ray_tpu.experimental — compiled-DAG channels and other pre-stable APIs."""

@@ -1,0 +1,1 @@
+"""Model zoo: TPU-native flax implementations used by the Train/bench stack."""

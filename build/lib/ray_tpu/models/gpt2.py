@@ -1,0 +1,176 @@
+"""GPT-2 in flax, written TPU-first.
+
+This is the framework's flagship train/bench model (BASELINE.json config 2:
+GPT-2-124M data-parallel). Design notes for the MXU/HBM:
+
+- all matmuls in bf16 with fp32 accumulation (`preferred_element_type`),
+  params kept in fp32 for the optimizer, cast per-step;
+- attention uses the fused pallas flash kernel when available
+  (ray_tpu/ops/attention.py), falling back to a plain einsum softmax that XLA
+  fuses well on TPU;
+- static shapes everywhere; the whole step is one jit;
+- tensor-parallel PartitionSpecs follow the Megatron layout: column-parallel
+  qkv/fc1 (shard output dim on 'tp'), row-parallel proj/fc2 (shard input dim),
+  so each block needs exactly one psum on the 'tp' axis per sublayer — XLA
+  inserts it from the shardings;
+- 'fsdp' shards every weight's first dim (ZeRO-3-style gather-per-layer under
+  pjit), 'sp' shards the sequence dim of activations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    block_size: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    use_flash_attention: bool = True
+    # Override the attention primitive, e.g. a shard_map-wrapped ring
+    # attention bound to a mesh (ray_tpu/parallel/train_step.py). Signature
+    # (q, k, v) -> out, all (B, T, H, D).
+    attn_fn: Any = None
+
+    @classmethod
+    def gpt2_124m(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=512, block_size=128, n_layer=2, n_head=4, n_embd=128)
+        base.update(kw)
+        return cls(**base)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        B, T, C = x.shape
+        head_dim = C // cfg.n_head
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, head_dim)
+        k = k.reshape(B, T, cfg.n_head, head_dim)
+        v = v.reshape(B, T, cfg.n_head, head_dim)
+
+        if cfg.attn_fn is not None:
+            y = cfg.attn_fn(q, k, v)
+        elif cfg.use_flash_attention:
+            from ray_tpu.ops.attention import causal_attention
+
+            y = causal_attention(q, k, v)
+        else:
+            att = jnp.einsum(
+                "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+            ) / math.sqrt(head_dim)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhts,bshd->bthd", att, v)
+        y = y.reshape(B, T, C)
+        return nn.Dense(C, dtype=cfg.dtype, name="c_proj")(y)
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic
+        )
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic
+        )
+        return x
+
+
+class GPT2(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, idx, deterministic=True):
+        cfg = self.config
+        B, T = idx.shape
+        pos = jnp.arange(T)[None]
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+        wpe = nn.Embed(cfg.block_size, cfg.n_embd, dtype=cfg.dtype, name="wpe")
+        x = wte(idx) + wpe(pos)
+        for i in range(cfg.n_layer):
+            # remat each block: recompute activations in the backward pass to
+            # trade FLOPs for HBM (jax.checkpoint).
+            x = nn.remat(Block)(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # weight-tied head
+        logits = wte.attend(x.astype(jnp.float32))
+        return logits
+
+
+def loss_fn(logits, targets):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def init_params(config: GPT2Config, rng=None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    model = GPT2(config)
+    idx = jnp.zeros((2, min(8, config.block_size)), dtype=jnp.int32)
+    return model.init(rng, idx)["params"]
+
+
+def forward(config: GPT2Config, params, idx):
+    return GPT2(config).apply({"params": params}, idx)
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# Megatron-style tensor-parallel layout + fsdp on the complementary dim.
+# Rule paths match flax param pytree paths like 'h_3/attn/c_attn/kernel'.
+GPT2_SHARDING_PATTERNS = [
+    (r"wte/embedding", P("tp", "fsdp")),
+    (r"wpe/embedding", P(None, "fsdp")),
+    (r"attn/c_attn/kernel", P("fsdp", "tp")),   # column parallel
+    (r"attn/c_attn/bias", P("tp")),
+    (r"attn/c_proj/kernel", P("tp", "fsdp")),   # row parallel
+    (r"attn/c_proj/bias", P()),
+    (r"mlp/c_fc/kernel", P("fsdp", "tp")),
+    (r"mlp/c_fc/bias", P("tp")),
+    (r"mlp/c_proj/kernel", P("tp", "fsdp")),
+    (r"mlp/c_proj/bias", P()),
+    (r"ln_", P()),
+]
+GPT2_SHARDING_RULES = ShardingRules(GPT2_SHARDING_PATTERNS, default=P())

@@ -1,0 +1,231 @@
+"""Llama-family decoder in flax, written TPU-first.
+
+Second model family of the zoo (beside GPT-2 and its MoE variant): RMSNorm,
+rotary position embeddings, grouped-query attention, SwiGLU MLP, untied LM
+head, no biases anywhere. The reference framework ships no model code at all
+(Ray Train wraps user torch models — reference
+python/ray/train/torch/torch_trainer.py:11); the zoo exists so the framework's
+Train/Tune/bench stack has first-party TPU workloads.
+
+TPU design notes:
+- all matmuls bf16 with fp32 accumulation; params fp32 for the optimizer;
+- RoPE is applied in fp32 (sin/cos precision matters at long context) and is
+  sequence-shift aware so it composes with sequence parallelism: pass
+  `pos_offset` to shift positions per sp shard;
+- GQA repeats KV heads via a broadcast-reshape that XLA folds into the
+  attention einsum — no materialized copy in HBM;
+- attention uses the fused pallas flash kernel via ops/attention.py, or an
+  injected `attn_fn` (e.g. a shard_map-wrapped ring attention for the 'sp'
+  axis, ray_tpu/parallel/train_step.py);
+- tensor-parallel layout is Megatron-style: column-parallel q/k/v/gate/up
+  (shard output dim on 'tp'), row-parallel o/down (shard input dim), one psum
+  per sublayer inserted by XLA from the shardings;
+- each block is wrapped in nn.remat (jax.checkpoint) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel.mesh import ShardingRules
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    block_size: int = 2048
+    n_layer: int = 8
+    n_head: int = 8
+    n_kv_head: int = 4
+    n_embd: int = 512
+    intermediate: Optional[int] = None  # default: the 8/3 SwiGLU rule, rounded
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    use_flash_attention: bool = True
+    # Override the attention primitive, e.g. ring attention bound to a mesh.
+    # Signature (q, k, v) -> out, all (B, T, H, D) with H == n_head.
+    attn_fn: Any = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def mlp_dim(self) -> int:
+        if self.intermediate is not None:
+            return self.intermediate
+        # 2/3 * 4 * n_embd rounded up to a multiple of 128 (MXU lane width).
+        raw = int(8 * self.n_embd / 3)
+        return (raw + 127) // 128 * 128
+
+    @classmethod
+    def tiny(cls, **kw):
+        base = dict(vocab_size=512, block_size=128, n_layer=2, n_head=4,
+                    n_kv_head=2, n_embd=128)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def llama_160m(cls, **kw):
+        base = dict(vocab_size=32000, block_size=1024, n_layer=12, n_head=12,
+                    n_kv_head=4, n_embd=768)
+        base.update(kw)
+        return cls(**base)
+
+
+def rms_norm(x, weight, eps):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(dtype) * weight
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return rms_norm(x, w.astype(x.dtype), self.eps)
+
+
+def rope_angles(head_dim: int, theta: float, positions):
+    """(T,) int positions -> (T, head_dim//2) fp32 angles."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    return positions.astype(jnp.float32)[:, None] * inv[None, :]
+
+
+def apply_rope(x, angles):
+    """x (B, T, H, D); angles (T, D//2). Rotate-half convention, fp32 math."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x32, 2, axis=-1)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset=0):
+        cfg = self.config
+        B, T, C = x.shape
+        hd = cfg.head_dim
+        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype, name=name)
+        q = dense(cfg.n_head * hd, "wq")(x).reshape(B, T, cfg.n_head, hd)
+        k = dense(cfg.n_kv_head * hd, "wk")(x).reshape(B, T, cfg.n_kv_head, hd)
+        v = dense(cfg.n_kv_head * hd, "wv")(x).reshape(B, T, cfg.n_kv_head, hd)
+
+        positions = jnp.arange(T) + pos_offset
+        ang = rope_angles(hd, cfg.rope_theta, positions)
+        q = apply_rope(q, ang)
+        k = apply_rope(k, ang)
+
+        if cfg.n_kv_head != cfg.n_head:
+            rep = cfg.n_head // cfg.n_kv_head
+            # broadcast-reshape; XLA folds this into the attention contraction
+            k = jnp.broadcast_to(k[:, :, :, None, :], (B, T, cfg.n_kv_head, rep, hd)
+                                 ).reshape(B, T, cfg.n_head, hd)
+            v = jnp.broadcast_to(v[:, :, :, None, :], (B, T, cfg.n_kv_head, rep, hd)
+                                 ).reshape(B, T, cfg.n_head, hd)
+
+        if cfg.attn_fn is not None:
+            y = cfg.attn_fn(q, k, v)
+        elif cfg.use_flash_attention:
+            from ray_tpu.ops.attention import causal_attention
+
+            y = causal_attention(q, k, v)
+        else:
+            att = jnp.einsum("bthd,bshd->bhts", q, k,
+                             preferred_element_type=jnp.float32) / math.sqrt(hd)
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask[None, None], att, -1e30)
+            att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhts,bshd->bthd", att, v)
+        y = y.reshape(B, T, cfg.n_head * hd)
+        return dense(C, "wo")(y)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = lambda n, name: nn.Dense(n, use_bias=False, dtype=cfg.dtype, name=name)
+        return dense(cfg.n_embd, "down")(
+            nn.silu(dense(cfg.mlp_dim, "gate")(x)) * dense(cfg.mlp_dim, "up")(x)
+        )
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, pos_offset=0):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), pos_offset
+        )
+        x = x + LlamaMLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, idx, pos_offset=0):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="tok_emb")(idx)
+        for i in range(cfg.n_layer):
+            x = nn.remat(LlamaBlock)(cfg, name=f"h_{i}")(x, pos_offset)
+        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          name="lm_head")(x.astype(jnp.float32))
+        return logits
+
+
+def loss_fn(logits, targets):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()
+
+
+def init_params(config: LlamaConfig, rng=None):
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    idx = jnp.zeros((2, min(8, config.block_size)), dtype=jnp.int32)
+    return Llama(config).init(rng, idx)["params"]
+
+
+def forward(config: LlamaConfig, params, idx, pos_offset=0):
+    return Llama(config).apply({"params": params}, idx, pos_offset)
+
+
+def num_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# Megatron-style TP layout + fsdp on the complementary dim. Paths are flax
+# pytree paths like 'h_3/attn/wq/kernel'.
+LLAMA_SHARDING_PATTERNS = [
+    (r"tok_emb/embedding", P("tp", "fsdp")),
+    (r"attn/w[qkv]/kernel", P("fsdp", "tp")),   # column parallel
+    (r"attn/wo/kernel", P("tp", "fsdp")),       # row parallel
+    (r"mlp/(gate|up)/kernel", P("fsdp", "tp")),
+    (r"mlp/down/kernel", P("tp", "fsdp")),
+    (r"lm_head/kernel", P("fsdp", "tp")),
+    (r"norm", P()),
+]
+LLAMA_SHARDING_RULES = ShardingRules(LLAMA_SHARDING_PATTERNS, default=P())

@@ -1,0 +1,1 @@
+"""TPU compute ops: pallas kernels with XLA fallbacks."""

@@ -1,0 +1,113 @@
+"""Ring attention: causal attention with the sequence axis sharded over a mesh.
+
+Green-field work — the reference has no sequence/context parallelism at all
+(verified in SURVEY.md §2.4: no ring-attention/Ulysses anywhere in it). Design:
+
+- q/k/v live sharded on the 'sp' mesh axis: each device holds a contiguous
+  sequence chunk (B, T/n, H, D).
+- K/V chunks rotate around the ring with `jax.lax.ppermute` (one ICI hop per
+  step, n-1 steps) while each device's q chunk stays put; communication
+  overlaps with the chunk attention compute under XLA's scheduler.
+- Per-chunk results merge with the standard streaming-softmax rule in
+  log-space (running logsumexpt), so the result is exactly softmax over the
+  full sequence — verified against single-device attention in tests.
+- Causality is enforced by *global* position masks (chunk offset = owner
+  device index × chunk length), so fully-masked chunks contribute -inf lse
+  and drop out of the merge.
+
+Use `ring_causal_attention` inside shard_map/pjit with an 'sp' axis, or the
+`ring_attention_sharded` convenience wrapper that builds the shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attn(q, k, v, q_offset, k_offset, scale):
+    """Attention of a q chunk over one k/v chunk with global causal masking.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D). Returns (o, lse) with
+    o: (B, Tq, H, D) fp32 *unnormalized by global softmax* (normalized within
+    chunk), lse: (B, Tq, H) log-sum-exp of this chunk's scores.
+    """
+    Tq, Tk = q.shape[1], k.shape[1]
+    s = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+    k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+    s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # (B, H, Tq)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    safe_m = jnp.maximum(m, -1e29)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where((q_pos >= k_pos)[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                                   # (B, H, Tq)
+    o = jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v,
+                   preferred_element_type=jnp.float32)
+    lse = jnp.where(l > 0, safe_m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    # o is sum(exp(s - safe_m) * v); caller renormalizes via lse
+    return o, lse.transpose(0, 2, 1), safe_m.transpose(0, 2, 1)  # (B, Tq, H)
+
+
+def ring_causal_attention(q, k, v, axis_name: str = "sp"):
+    """Causal attention across the ring; call inside shard_map over axis_name.
+
+    q/k/v: local chunks (B, Tl, H, D). Returns (B, Tl, H, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    q_offset = idx * Tl
+
+    def step(carry, s):
+        k_cur, v_cur, acc, lse_acc = carry
+        owner = (idx - s) % n            # which device's chunk we hold now
+        k_offset = owner * Tl
+        o, lse, m = _chunk_attn(q, k_cur, v_cur, q_offset, k_offset, scale)
+        # merge (streaming softmax in log space); o is scaled by exp(-m)
+        new_lse = jnp.logaddexp(lse_acc, lse)
+        w_old = jnp.exp(jnp.clip(lse_acc - new_lse, -80, 0))
+        w_new = jnp.exp(jnp.clip(lse - new_lse, -80, 0))
+        # o currently = softmax-numerator / exp(m) → renormalize by exp(lse - m)
+        o_norm = o * jnp.exp(jnp.clip(m - lse, -80, 80))[..., None].transpose(0, 1, 2, 3)
+        acc = acc * w_old[..., None] + o_norm * w_new[..., None]
+        lse_acc = new_lse
+        # rotate k/v to the next device (ring over ICI)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, lse_acc), None
+
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    lse0 = jnp.full((B, Tl, H), NEG_INF, jnp.float32)
+    (k_f, v_f, acc, lse_acc), _ = jax.lax.scan(
+        step, (k, v, acc0, lse0), jnp.arange(n)
+    )
+    return acc.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                           batch_axes=("dp", "fsdp")):
+    """Global-array convenience wrapper: shard_map over the sequence axis."""
+    from jax import shard_map
+
+    data = tuple(a for a in batch_axes if a in mesh.axis_names)
+    spec = P(data if data else None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_causal_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,1 @@
+"""Mesh construction and sharding-rule helpers for pjit/shard_map."""

@@ -1,0 +1,159 @@
+"""Device-mesh construction and sharding-rule utilities.
+
+This is the TPU-native substitute for the reference's NCCL process groups
+(reference: python/ray/util/collective/collective_group/nccl_collective_group.py):
+instead of creating communicator handles and calling collectives imperatively,
+we build a `jax.sharding.Mesh` over the slice's devices, annotate arrays with
+`NamedSharding`s, and let XLA insert ICI collectives during compilation
+(psum/all-gather/reduce-scatter chosen by the partitioner).
+
+Axis conventions used across the framework:
+  dp    — data parallel (batch dimension)
+  fsdp  — parameter/optimizer sharding (ZeRO-style), usually merged with dp
+  tp    — tensor parallel (hidden/heads dimension)
+  sp    — sequence/context parallel (ring attention rides this axis)
+  ep    — expert parallel (MoE)
+  pp    — pipeline stages (handled by the compiled-DAG layer, not the mesh)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Dict[str, int],
+    *,
+    devices: Optional[Sequence] = None,
+    allow_split_physical: bool = True,
+) -> Mesh:
+    """Build a Mesh with the given axis sizes (-1 once to mean 'the rest').
+
+    Axis order in `axes` is the layout order: the last axis varies fastest over
+    the device list, so put the most bandwidth-hungry axis (tp, then dp) last —
+    adjacent devices share the fastest ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("only one axis may be -1")
+    if unknown:
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(f"mesh axes {sizes} need {total} devices, have {n}")
+    arr = np.array(devices).reshape(*sizes.values())
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def single_axis_mesh(name: str = "dp", devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (name,))
+
+
+class ShardingRules:
+    """Map parameter-path regexes to PartitionSpecs.
+
+    Rules are checked in order; first match wins. Paths are '/'-joined pytree
+    key paths, e.g. 'transformer/h_3/attn/c_attn/kernel'.
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._default = default
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return spec
+        return self._default
+
+    def tree_specs(self, tree):
+        """PartitionSpec pytree matching `tree`'s structure."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = []
+        for keypath, _leaf in flat:
+            path = "/".join(_key_str(k) for k in keypath)
+            specs.append(self.spec_for(path))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, tree, mesh: Mesh):
+        specs = self.tree_specs(tree)
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def filter_spec_for_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have (lets one rule set serve many
+    mesh shapes — e.g. tp rules are no-ops on a pure-dp mesh)."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in mesh.axis_names and mesh.shape[e] > 1)
+            return kept if kept else None
+        return entry if entry in mesh.axis_names and mesh.shape[entry] > 1 else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def filtered_tree_specs(rules: ShardingRules, tree, mesh: Mesh):
+    """Rule-derived PartitionSpecs with axes the mesh lacks dropped."""
+    specs = rules.tree_specs(tree)
+    return jax.tree.map(lambda s: filter_spec_for_mesh(s, mesh), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def filtered_tree_shardings(rules: ShardingRules, tree, mesh: Mesh):
+    specs = filtered_tree_specs(rules, tree, mesh)
+    return specs, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                               is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(tree, mesh: Mesh, rules: ShardingRules):
+    """device_put a pytree with rule-derived (mesh-filtered) shardings."""
+    _, shardings = filtered_tree_shardings(rules, tree, mesh)
+    return jax.device_put(tree, shardings), shardings
+
+
+def batch_sharding(mesh: Mesh, *, data_axes=("dp", "fsdp"), seq_axis="sp") -> NamedSharding:
+    """Sharding for a [batch, seq, ...] input batch."""
+    data = tuple(a for a in data_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    seq = seq_axis if seq_axis in mesh.axis_names and mesh.shape[seq_axis] > 1 else None
+    return NamedSharding(mesh, P(data if data else None, seq))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_slice_info() -> dict:
+    """Topology of the slice this process sees."""
+    devs = jax.devices()
+    return {
+        "num_devices": len(devs),
+        "num_local_devices": len(jax.local_devices()),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "platform": devs[0].platform if devs else "none",
+        "device_kind": devs[0].device_kind if devs else "",
+    }

@@ -1,0 +1,262 @@
+"""Sharded training step builder: one jit, every parallelism axis.
+
+This is the TPU-native replacement for the reference's torch DDP/FSDP wrapper
+stack (reference: python/ray/train/torch/train_loop_utils.py:453 prepare_model
+→ DDP, :184 FSDP): instead of wrapping modules and calling NCCL imperatively,
+we build a `jax.sharding.Mesh`, assign PartitionSpecs to params/optimizer
+state/batch, and compile ONE train step under jit — XLA inserts the ICI
+collectives (grad psums over dp, param all-gathers over fsdp, activation
+collectives over tp, ring ppermutes over sp) from the shardings.
+
+Axes (any subset may be trivial/size-1, one rule set serves all):
+  dp    batch;                 grads psum over it (DDP-equivalent)
+  fsdp  param/optimizer shard; ZeRO-3-equivalent, also carries batch
+  tp    Megatron tensor parallel over hidden/head dims
+  sp    sequence/context parallel; attention runs a ppermute ring
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.models.gpt2 import (
+    GPT2,
+    GPT2Config,
+    GPT2_SHARDING_RULES,
+    loss_fn,
+)
+from ray_tpu.parallel.mesh import (
+    ShardingRules,
+    batch_sharding,
+    filtered_tree_shardings,
+)
+
+
+def _ring_attn_for_mesh(mesh: Mesh, seq_axis: str = "sp"):
+    """Attention callable for GPT2Config.attn_fn: ring attention over the
+    sequence axis via shard_map, local flash attention per chunk-pair."""
+    from jax import shard_map
+
+    from ray_tpu.ops.ring_attention import ring_causal_attention
+
+    data = tuple(
+        a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+    spec = P(data if data else None, seq_axis, tp, None)  # (B, T, H, D)
+
+    fn = shard_map(
+        functools.partial(ring_causal_attention, axis_name=seq_axis),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn
+
+
+def model_for_mesh(cfg, mesh: Optional[Mesh]):
+    """Instantiate the model wired for this mesh: ring attention iff sp > 1;
+    config type picks the family (GPT2 / GPT2MoE with an ep axis / Llama)."""
+    import dataclasses
+
+    if (
+        mesh is not None
+        and "sp" in mesh.axis_names
+        and mesh.shape["sp"] > 1
+    ):
+        cfg = dataclasses.replace(cfg, attn_fn=_ring_attn_for_mesh(mesh))
+    from ray_tpu.models.gpt2_moe import GPT2MoE, GPT2MoEConfig
+    from ray_tpu.models.llama import Llama, LlamaConfig
+
+    if isinstance(cfg, GPT2MoEConfig):
+        return GPT2MoE(cfg)
+    if isinstance(cfg, LlamaConfig):
+        return Llama(cfg)
+    return GPT2(cfg)
+
+
+# Backwards-compatible alias (pre-Llama name).
+gpt2_model_for_mesh = model_for_mesh
+
+
+def default_rules_for(cfg) -> ShardingRules:
+    from ray_tpu.models.gpt2_moe import GPT2_MOE_SHARDING_RULES, GPT2MoEConfig
+    from ray_tpu.models.llama import LLAMA_SHARDING_RULES, LlamaConfig
+
+    if isinstance(cfg, GPT2MoEConfig):
+        return GPT2_MOE_SHARDING_RULES
+    if isinstance(cfg, LlamaConfig):
+        return LLAMA_SHARDING_RULES
+    return GPT2_SHARDING_RULES
+
+
+class TrainStep:
+    """Compiled (init, step) pair with sharded state.
+
+    Usage:
+        ts = TrainStep(GPT2Config.tiny(), mesh)
+        state = ts.init(jax.random.PRNGKey(0))
+        state, metrics = ts.step(state, batch)   # batch: dict idx/targets (B, T)
+    """
+
+    def __init__(
+        self,
+        model_cfg: GPT2Config,
+        mesh: Mesh,
+        *,
+        learning_rate: float = 3e-4,
+        weight_decay: float = 0.1,
+        beta2: float = 0.95,
+        grad_clip: float = 1.0,
+        rules: Optional[ShardingRules] = None,
+    ):
+        from ray_tpu.models.gpt2_moe import GPT2MoEConfig
+
+        self._is_moe = isinstance(model_cfg, GPT2MoEConfig)
+        if rules is None:
+            rules = default_rules_for(model_cfg)
+        self.model_cfg = model_cfg
+        self.mesh = mesh
+        self.model = model_for_mesh(model_cfg, mesh)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adamw(
+                learning_rate, b2=beta2, weight_decay=weight_decay,
+                mask=lambda params: jax.tree.map(lambda p: p.ndim > 1, params),
+            ),
+        )
+        self.batch_sharding = batch_sharding(mesh)
+
+        def init_fn(rng):
+            T = min(8, model_cfg.block_size)
+            idx = jnp.zeros((2, T), dtype=jnp.int32)
+            params = self.model.init(rng, idx)["params"]
+            return {
+                "params": params,
+                "opt_state": self.optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+
+        state_shape = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        self.state_specs, self.state_shardings = filtered_tree_shardings(
+            rules, state_shape, mesh
+        )
+        self._init = jax.jit(init_fn, out_shardings=self.state_shardings)
+
+        def step_fn(state, batch):
+            def loss_of(params):
+                if self._is_moe:
+                    logits, lstate = self.model.apply(
+                        {"params": params}, batch["idx"], mutable=["losses"]
+                    )
+                    aux = sum(jax.tree.leaves(lstate.get("losses", {})))
+                    return loss_fn(logits, batch["targets"]) + aux
+                logits = self.model.apply({"params": params}, batch["idx"])
+                return loss_fn(logits, batch["targets"])
+
+            loss, grads = jax.value_and_grad(loss_of)(state["params"])
+            updates, opt_state = self.optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            new_state = {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            }
+            gnorm = optax.global_norm(grads)
+            return new_state, {"loss": loss, "grad_norm": gnorm}
+
+        self._step = jax.jit(
+            step_fn,
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+        self._step_fn = step_fn
+        self._traced = False
+        self._multi: Dict[int, Any] = {}
+        self._tiled_cache = None
+
+    def init(self, rng) -> Dict[str, Any]:
+        with self.mesh:
+            return self._init(rng)
+
+    def shard_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        return jax.device_put(batch, self.batch_sharding)
+
+    def step(self, state, batch) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        # No mesh context on the hot path: in/out shardings are explicit
+        # NamedShardings, so dispatch doesn't need the ambient mesh — the
+        # context manager costs real per-step Python time at small step
+        # sizes. First call traces under the mesh (shard_map ring attention
+        # resolves its axis names there), then cached dispatch skips it.
+        if self._traced:
+            return self._step(state, batch)
+        with self.mesh:
+            out = self._step(state, batch)
+        self._traced = True
+        return out
+
+    def multi_step(self, state, batches, num_steps: int):
+        """Run `num_steps` optimizer steps in ONE dispatch via lax.scan
+        (XLA-idiomatic: python per-call dispatch costs ~1-3ms, a compiled
+        scan body costs nothing — at short step times the scan is the
+        difference between dispatch-bound and MXU-bound).
+
+        `batches`: dict of arrays with a leading (num_steps, ...) axis
+        (stacked micro-batches), or a single batch dict to reuse each step.
+        Returns (state, metrics) with metrics stacked over steps."""
+        key = num_steps
+        fn = self._multi.get(key)
+        first = fn is None
+        if first:
+            def body(state, batch):
+                new_state, m = self._step_fn(state, batch)
+                return new_state, m
+
+            def run(state, batches):
+                return jax.lax.scan(body, state, batches, length=num_steps)
+
+            fn = jax.jit(
+                run,
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=(0,),
+            )
+            self._multi[key] = fn
+        # tile-or-not is decided per call from the actual layout (a cached
+        # flag goes stale when batch layout or num_steps changes): a batch
+        # is already stacked iff it carries the extra leading num_steps axis
+        sample = next(iter(batches.values()))
+        if sample.ndim < 3 or sample.shape[0] != num_steps:
+            # reuse-one-batch convenience: tile once and cache — a per-call
+            # broadcast adds a dispatch to every chunk. The cache holds
+            # STRONG refs to the source arrays, so an id()-reuse after GC
+            # can never produce a false hit.
+            src = (num_steps,) + tuple(batches.values())
+            cached = self._tiled_cache
+            hit = (
+                cached is not None
+                and len(cached[0]) == len(src)
+                and all(a is b for a, b in zip(cached[0], src))
+            )
+            if not hit:
+                tiled = jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x[None], (num_steps,) + x.shape),
+                    batches,
+                )
+                self._tiled_cache = (src, tiled)
+            batches = self._tiled_cache[1]
+        if not first:
+            # cached dispatch needs no ambient mesh (explicit shardings);
+            # the context manager costs ~1ms/call
+            return fn(state, batches)
+        with self.mesh:
+            return fn(state, batches)

@@ -1,0 +1,10 @@
+"""ray_tpu.rllib — RL training: CPU env-runner actors + jax mesh learners.
+
+Reference: rllib/ (SURVEY.md §2.3) — the new-stack slice: EnvRunnerGroup,
+LearnerGroup, PPO. The torch-DDP learner is re-designed as a pjit'd update
+over a jax device mesh (north-star config 3: CPU rollouts + TPU learner).
+"""
+
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401

@@ -1,0 +1,97 @@
+"""RLModule: the policy/value network (reference: rllib/core/rl_module/ —
+re-designed as a flax module; the torch DDP wrapper is replaced by pjit
+sharding in the learner).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ActorCriticModule(nn.Module):
+    """Shared-nothing MLP actor-critic for discrete action spaces."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        logits = nn.Dense(self.num_actions, name="pi")(x)
+        v = nn.Dense(1, name="vf")(x)
+        return logits, jnp.squeeze(v, -1)
+
+    def init_params(self, obs_dim: int, seed: int = 0):
+        return self.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim), jnp.float32)
+        )["params"]
+
+
+def numpy_forward(params, obs: np.ndarray):
+    """Pure-numpy forward pass so CPU env runners act without importing a jax
+    device runtime (reference: env runners hold a lightweight policy copy).
+    Mirrors ActorCriticModule's architecture exactly."""
+    x = obs.astype(np.float32)
+    # numeric sort: flax auto-names are Dense_0..Dense_N and 'Dense_10'
+    # sorts lexicographically before 'Dense_2'
+    layers = sorted((k for k in params if k.startswith("Dense_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
+    for k in layers:
+        x = np.tanh(x @ np.asarray(params[k]["kernel"])
+                    + np.asarray(params[k]["bias"]))
+    logits = x @ np.asarray(params["pi"]["kernel"]) + np.asarray(
+        params["pi"]["bias"])
+    v = x @ np.asarray(params["vf"]["kernel"]) + np.asarray(
+        params["vf"]["bias"])
+    return logits, v[:, 0]
+
+
+class QModule(nn.Module):
+    """MLP Q-network for discrete action spaces (DQN family)."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_actions, name="q")(x)
+
+    def init_params(self, obs_dim: int, seed: int = 0):
+        return self.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim), jnp.float32)
+        )["params"]
+
+
+def numpy_q_forward(params, obs: np.ndarray):
+    """Numpy mirror of QModule for CPU env runners (relu hidden stack)."""
+    x = obs.astype(np.float32)
+    layers = sorted((k for k in params if k.startswith("Dense_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
+    for k in layers:
+        x = np.maximum(
+            x @ np.asarray(params[k]["kernel"]) + np.asarray(params[k]["bias"]),
+            0.0,
+        )
+    return x @ np.asarray(params["q"]["kernel"]) + np.asarray(
+        params["q"]["bias"])
+
+
+def sample_actions(rng: np.random.Generator, logits: np.ndarray):
+    """Categorical sample + log-prob, numpy."""
+    z = logits - logits.max(axis=-1, keepdims=True)
+    p = np.exp(z)
+    p /= p.sum(axis=-1, keepdims=True)
+    u = rng.random((len(p), 1))
+    actions = (p.cumsum(axis=-1) > u).argmax(axis=-1)
+    logp = np.log(p[np.arange(len(p)), actions] + 1e-12)
+    return actions, logp

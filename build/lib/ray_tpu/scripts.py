@@ -1,0 +1,251 @@
+"""Operator CLI: ``python -m ray_tpu.scripts <command>``.
+
+Counterpart of the reference's ``ray`` CLI
+(reference: python/ray/scripts/scripts.py — start :571, stop, status,
+memory, timeline, logs, plus the job CLI dashboard/modules/job/cli.py).
+
+Commands:
+  start --head [--port P] [--resources JSON] [--dashboard-port P]
+  start --address HOST:PORT [--resources JSON]     (worker node)
+  stop
+  status   [--address]
+  nodes    [--address]
+  actors   [--address]
+  memory   [--address]           object-store usage per node
+  timeline [--address] -o FILE   Chrome-trace dump
+  job submit  --address ADDR -- ENTRYPOINT...
+  job status  --address ADDR SUBMISSION_ID
+  job logs    --address ADDR SUBMISSION_ID
+  job stop    --address ADDR SUBMISSION_ID
+  job list    --address ADDR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_STATE_FILE = os.path.join(
+    os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "cli_cluster.json"
+)
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RTPU_ADDRESS")
+    if not addr and os.path.exists(_STATE_FILE):
+        with open(_STATE_FILE) as f:
+            addr = json.load(f).get("gcs_address")
+    if not addr:
+        sys.exit("no cluster address: pass --address or set RTPU_ADDRESS")
+    return addr
+
+
+def cmd_start(args):
+    from ray_tpu._private.node import Node
+
+    resources = json.loads(args.resources) if args.resources else None
+    if args.head:
+        node = Node(head=True, resources=resources)
+        info = {
+            "gcs_address": node.gcs_address,
+            "session_dir": node.session_dir,
+            "pids": [p.pid for p in node.processes.values()],
+        }
+        if args.dashboard_port >= 0:
+            import subprocess
+
+            port_file = os.path.join(node.session_dir, "dashboard_port")
+            env = dict(os.environ)
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            dash_out = open(
+                os.path.join(node.session_dir, "logs", "dashboard.out"), "ab"
+            )
+            dash_err = open(
+                os.path.join(node.session_dir, "logs", "dashboard.err"), "ab"
+            )
+            dash = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu.dashboard.head",
+                    f"--gcs-address={node.gcs_address}",
+                    f"--port={args.dashboard_port}",
+                    f"--port-file={port_file}",
+                ],
+                env=env,
+                stdout=dash_out,
+                stderr=dash_err,
+                start_new_session=True,
+            )
+            info["pids"].append(dash.pid)
+            from ray_tpu._private.node import _wait_port_file
+
+            info["dashboard_port"] = _wait_port_file(port_file, dash)
+            print(f"dashboard: http://127.0.0.1:{info['dashboard_port']}")
+        os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
+        with open(_STATE_FILE, "w") as f:
+            json.dump(info, f)
+        print(f"head started; GCS at {node.gcs_address}")
+        print(f"connect with: ray_tpu.init(address='{node.gcs_address}')")
+        # The supervising Node object must stay alive for the GCS monitor;
+        # detach by keeping this process around unless --block=false-like
+        # behavior is wanted. The processes themselves are daemons of no
+        # one (start_new_session), so exiting here is safe: monitoring
+        # simply stops.
+        node._gcs_monitor = None
+    else:
+        addr = _resolve_address(args)
+        node = Node(head=False, gcs_address=addr, resources=resources)
+        print(f"worker node started; raylet on port {node.raylet_port}")
+
+
+def cmd_stop(args):
+    import signal
+
+    if not os.path.exists(_STATE_FILE):
+        sys.exit("no recorded cluster (started with this CLI?)")
+    with open(_STATE_FILE) as f:
+        info = json.load(f)
+    for pid in info.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped pid {pid}")
+        except ProcessLookupError:
+            pass
+    os.remove(_STATE_FILE)
+
+
+def cmd_status(args):
+    from ray_tpu._private.gcs.client import GcsClient
+
+    addr = _resolve_address(args)
+    gcs = GcsClient.from_address(addr)
+    res = gcs.get_cluster_resources()
+    nodes = gcs.get_all_node_info()
+    alive = [n for n in nodes if n["state"] == "ALIVE"]
+    print(f"cluster at {addr}: {len(alive)} alive / {len(nodes)} total nodes")
+    print("resources:")
+    for k in sorted(res["total"]):
+        print(f"  {res['available'].get(k, 0):.1f}/{res['total'][k]:.1f} {k}")
+
+
+def cmd_nodes(args):
+    from ray_tpu.util import state
+
+    for n in state.list_nodes(_resolve_address(args)):
+        print(
+            f"{n['node_id'][:12]} {n['state']:<6} {n['node_ip']}:"
+            f"{n['raylet_port']} head={n['is_head_node']} {n['resources_total']}"
+        )
+
+
+def cmd_actors(args):
+    from ray_tpu.util import state
+
+    for a in state.list_actors(_resolve_address(args)):
+        name = a["name"] or "-"
+        print(f"{a['actor_id'][:12]} {a['state']:<8} name={name}")
+
+
+def cmd_memory(args):
+    from ray_tpu.util import state
+
+    objs = state.list_objects(_resolve_address(args))
+    by_node = {}
+    for o in objs:
+        st = by_node.setdefault(o["node_id"], {"n": 0, "bytes": 0, "spilled": 0})
+        st["n"] += 1
+        st["bytes"] += o.get("size_bytes") or 0
+        st["spilled"] += 1 if o.get("spilled") else 0
+    for node, st in by_node.items():
+        print(f"{node[:12]}: {st['n']} objects, {st['bytes']} bytes, "
+              f"{st['spilled']} spilled")
+    if not by_node:
+        print("no objects")
+
+
+def cmd_timeline(args):
+    from ray_tpu._private.gcs.client import GcsClient
+    from ray_tpu._private.timeline import chrome_trace_events
+
+    gcs = GcsClient.from_address(_resolve_address(args))
+    events = chrome_trace_events(
+        gcs.call("GetTaskEvents", {"limit": 100_000})["events"]
+    )
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+def cmd_job(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    if args.job_cmd == "submit":
+        import shlex
+
+        entrypoint = [a for a in args.entrypoint if a != "--"]
+        sid = client.submit_job(
+            entrypoint=" ".join(shlex.quote(a) for a in entrypoint)
+        )
+        print(sid)
+        if args.wait:
+            for chunk in client.tail_job_logs(sid):
+                sys.stdout.write(chunk)
+            print(f"status: {client.get_job_status(sid)}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.submission_id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.submission_id) else "not running")
+    elif args.job_cmd == "list":
+        for j in client.list_jobs():
+            print(f"{j['submission_id']}  {j['status']:<10} {j['entrypoint']}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--resources", default=None)
+    p.add_argument("--dashboard-port", type=int, default=-1,
+                   help=">=0 to start the dashboard (0 = auto port)")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("status", cmd_status), ("nodes", cmd_nodes),
+                     ("actors", cmd_actors), ("memory", cmd_memory)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--address", default=None)
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("job")
+    p.add_argument("--address", default=None)
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("submission_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+"""ray_tpu.serve — model serving on the actor runtime.
+
+Counterpart of Ray Serve's public API (reference: python/ray/serve/api.py —
+serve.run :535, @serve.deployment, handles serve/handle.py:714). Minimal
+but real: a detached controller reconciles replica actors per deployment,
+an HTTP proxy routes by route_prefix, DeploymentHandles load-balance with
+power-of-two-choices, composition passes handles for bound sub-apps, and
+request-based autoscaling adjusts replica counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import cloudpickle
+
+from ray_tpu.serve._deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    deployment,
+)
+from ray_tpu.serve._handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+
+__all__ = [
+    "deployment",
+    "run",
+    "start",
+    "shutdown",
+    "delete",
+    "get_app_handle",
+    "get_deployment_handle",
+    "status",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+]
+
+
+def _get_or_create_controller():
+    import ray_tpu
+    from ray_tpu.serve._controller import ServeController
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    try:
+        return (
+            ray_tpu.remote(ServeController)
+            .options(
+                name=CONTROLLER_NAME,
+                lifetime="detached",
+                max_concurrency=16,
+                num_cpus=0,
+            )
+            .remote()
+        )
+    except Exception:
+        # Raced another creator for the name.
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def start(http_port: int = 0) -> int:
+    """Ensure the controller (and HTTP proxy) are running; returns the
+    proxy port."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    _blocking_ready_timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment."""
+    import ray_tpu
+
+    if not isinstance(app, Application):
+        raise TypeError("serve.run expects a bound Application (use .bind())")
+    controller = _get_or_create_controller()
+    specs = []
+    from ray_tpu.serve._deployment import _HandleRef
+
+    def scope(v):
+        # Deployments are app-scoped (reference namespaces deployment names
+        # per application): two apps may both have a 'Model' without
+        # clobbering each other.
+        if isinstance(v, _HandleRef):
+            return _HandleRef(f"{name}#{v.deployment_name}")
+        return v
+
+    for dep, init_args, init_kwargs in app.flatten():
+        specs.append(
+            {
+                "name": f"{name}#{dep.name}",
+                "callable": cloudpickle.dumps(dep.func_or_class),
+                "init_args": tuple(scope(a) for a in init_args),
+                "init_kwargs": {k: scope(v) for k, v in init_kwargs.items()},
+                "num_replicas": dep.num_replicas,
+                "max_ongoing_requests": dep.max_ongoing_requests,
+                "ray_actor_options": dep.ray_actor_options,
+                "autoscaling_config": dep.autoscaling_config,
+                "health_check_period_s": dep.health_check_period_s,
+            }
+        )
+    ingress = ray_tpu.get(
+        controller.deploy_application.remote(name, route_prefix, specs),
+        timeout=120,
+    )
+    handle = DeploymentHandle(ingress)
+    # Wait until at least one ingress replica answers (reference: serve.run
+    # blocks until the application is RUNNING).
+    deadline = time.time() + _blocking_ready_timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            names = ray_tpu.get(
+                controller.get_replica_names.remote(ingress), timeout=30
+            )
+            if names:
+                replica = ray_tpu.get_actor(names[0])
+                ray_tpu.get(replica.get_metadata.remote(), timeout=30)
+                return handle
+        except Exception as e:
+            last = e
+        time.sleep(0.25)
+    raise TimeoutError(f"application '{name}' did not become ready: {last}")
+
+
+def delete(name: str):
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(controller.get_app_info.remote(name), timeout=30)
+    if info is None:
+        raise ValueError(f"no application named '{name}'")
+    return DeploymentHandle(info["ingress"])
+
+
+def get_deployment_handle(
+    deployment_name: str, app_name: str = "default"
+) -> DeploymentHandle:
+    return DeploymentHandle(f"{app_name}#{deployment_name}")
+
+
+def status() -> dict:
+    import ray_tpu
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.list_apps.remote(), timeout=30)
+
+
+def shutdown():
+    """Tear down all applications, replicas, the proxy and controller."""
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        apps = ray_tpu.get(controller.list_apps.remote(), timeout=30)
+        for name in apps:
+            ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+    except Exception:
+        pass
+    try:
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass

@@ -1,0 +1,143 @@
+"""Deployment / Application: the declarative layer of Serve.
+
+Counterpart of the reference's deployment decorator + bound application
+graph (reference: python/ray/serve/deployment.py — Deployment.bind,
+serve/api.py:535 serve.run). ``@serve.deployment`` wraps a class or
+function; ``.bind(*args)`` produces an Application node whose arguments may
+themselves be Applications (model composition — inner apps become their own
+deployments and the outer one receives DeploymentHandles).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+    def to_dict(self):
+        return self.__dict__.copy()
+
+
+class Deployment:
+    def __init__(
+        self,
+        func_or_class,
+        name: str,
+        num_replicas: int = 1,
+        ray_actor_options: Optional[dict] = None,
+        max_ongoing_requests: int = 8,
+        autoscaling_config: Optional[dict] = None,
+        health_check_period_s: float = 2.0,
+    ):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = dict(ray_actor_options or {})
+        self.max_ongoing_requests = max_ongoing_requests
+        if isinstance(autoscaling_config, AutoscalingConfig):
+            autoscaling_config = autoscaling_config.to_dict()
+        self.autoscaling_config = autoscaling_config
+        self.health_check_period_s = health_check_period_s
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = {
+            "name": self.name,
+            "num_replicas": self.num_replicas,
+            "ray_actor_options": self.ray_actor_options,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "autoscaling_config": self.autoscaling_config,
+            "health_check_period_s": self.health_check_period_s,
+        }
+        cfg.update(overrides)
+        return Deployment(self.func_or_class, **cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise RuntimeError(
+            "Deployments are not directly callable; use .bind() + serve.run, "
+            "then handle.remote()"
+        )
+
+
+class Application:
+    """A bound deployment DAG node."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def flatten(self) -> List[Tuple[Deployment, tuple, dict]]:
+        """Topological list of (deployment, init_args, init_kwargs) with
+        nested Applications replaced by handle placeholders."""
+        out: List[Tuple[Deployment, tuple, dict]] = []
+        seen: Dict[int, str] = {}
+
+        def visit(app: "Application") -> "_HandleRef":
+            if id(app) in seen:
+                return _HandleRef(seen[id(app)])
+            args = tuple(
+                visit(a) if isinstance(a, Application) else a for a in app.args
+            )
+            kwargs = {
+                k: visit(v) if isinstance(v, Application) else v
+                for k, v in app.kwargs.items()
+            }
+            name = app.deployment.name
+            suffix = 1
+            while any(d.name == name for d, _, _ in out):
+                suffix += 1
+                name = f"{app.deployment.name}_{suffix}"
+            dep = app.deployment.options(name=name) if name != app.deployment.name else app.deployment
+            seen[id(app)] = name
+            out.append((dep, args, kwargs))
+            return _HandleRef(name)
+
+        visit(self)
+        return out
+
+
+@dataclass
+class _HandleRef:
+    """Placeholder in init args, resolved to a DeploymentHandle at replica
+    construction time."""
+
+    deployment_name: str
+
+
+def deployment(
+    _func_or_class=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    ray_actor_options: Optional[dict] = None,
+    max_ongoing_requests: int = 8,
+    autoscaling_config: Optional[dict] = None,
+    health_check_period_s: float = 2.0,
+):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+
+    def wrap(func_or_class):
+        return Deployment(
+            func_or_class,
+            name=name or getattr(func_or_class, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            health_check_period_s=health_check_period_s,
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap

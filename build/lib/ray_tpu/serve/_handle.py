@@ -1,0 +1,332 @@
+"""DeploymentHandle: the client side of a deployment.
+
+Counterpart of the reference's handle → router → replica-scheduler chain
+(reference: python/ray/serve/handle.py:714 DeploymentHandle,
+_private/router.py:320, _private/replica_scheduler/pow_2_scheduler.py:49
+PowerOfTwoChoicesReplicaScheduler). Replica-set changes arrive by
+LONG-POLL push from the controller (reference: _private/long_poll.py) — a
+background updater holds a poll open and applies new sets the moment the
+controller reconciles, so scale-downs re-route within one poll instead of
+a TTL window. Each call picks two random replicas and PROBES their actual
+queue depths (pow-2 with probes, like the reference's scheduler), falling
+back to handle-local in-flight counts when a probe times out.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_POLL_TIMEOUT_S = 20.0
+_PROBE_TIMEOUT_S = 0.5
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef
+    (reference: serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class StreamingResponse:
+    """Iterator over a streaming deployment call (reference:
+    serve/handle.py DeploymentResponseGenerator): the replica runs the
+    generator; items arrive in pulled batches."""
+
+    def __init__(self, replica, stream_id: str, handle, idx: int):
+        self._replica = replica
+        self._stream_id = stream_id
+        self._handle = handle
+        self._idx = idx
+        self._buf: List[Any] = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        while not self._buf:
+            if self._done:
+                self._finish()
+                raise StopIteration
+            reply = ray_tpu.get(
+                self._replica.next_stream_items.remote(self._stream_id),
+                timeout=120,
+            )
+            self._buf.extend(reply["items"])
+            self._done = reply["done"]
+        return self._buf.pop(0)
+
+    def _finish(self):
+        if self._handle is not None:
+            self._handle._done(self._idx)
+            self._handle = None
+
+    def close(self):
+        """Abandon the stream: frees the replica-side generator."""
+        if not self._done:
+            self._done = True
+            try:
+                self._replica.cancel_stream.remote(self._stream_id)
+            except Exception:
+                pass
+        self._finish()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = "", stream: bool = False):
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._model_id = multiplexed_model_id
+        self._stream = stream
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []
+        self._replica_names: List[str] = []
+        self._version = -1
+        self._inflight: Dict[str, int] = {}  # replica name -> in-flight
+        self._poller: Optional[threading.Thread] = None
+        self._closed = False
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self._method, self._model_id,
+                 self._stream))
+
+    def options(self, method_name: Optional[str] = None, *,
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id,
+            self._stream if stream is None else stream,
+        )
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name, self._model_id,
+                                self._stream)
+
+    def _apply_names(self, names: List[str], version: int):
+        import ray_tpu
+
+        replicas = []
+        kept = []
+        for n in names:
+            try:
+                replicas.append(ray_tpu.get_actor(n))
+                kept.append(n)
+            except Exception:
+                pass
+        with self._lock:
+            self._replicas = replicas
+            self._replica_names = kept
+            self._version = version
+            # in-flight counts keyed by NAME so surviving replicas keep
+            # their counts across set changes
+            self._inflight = {
+                n: self._inflight.get(n, 0) for n in kept
+            }
+
+    def _poll_loop(self):
+        """Background long-poll: applies replica-set changes the moment
+        the controller publishes them. The thread is bound to ONE runtime
+        session — after ray_tpu.shutdown (tests, notebooks) it retires
+        instead of polling a dead or unrelated cluster; the next call on
+        the handle starts a fresh poller in the new session."""
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        my_worker = worker_mod.global_worker
+        try:
+            while not self._closed:
+                if worker_mod.global_worker is not my_worker:
+                    return
+                try:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    r = ray_tpu.get(
+                        controller.poll_replica_names.remote(
+                            self.deployment_name, self._version,
+                            _POLL_TIMEOUT_S,
+                        ),
+                        timeout=_POLL_TIMEOUT_S + 15,
+                    )
+                    if r["version"] != self._version or not self._replicas:
+                        self._apply_names(r["names"], r["version"])
+                except Exception:
+                    for _ in range(10):
+                        if (self._closed
+                                or worker_mod.global_worker is not my_worker):
+                            return
+                        time.sleep(0.1)
+        finally:
+            with self._lock:
+                if self._poller is threading.current_thread():
+                    self._poller = None
+
+    def _refresh_replicas(self, force: bool = False):
+        with self._lock:
+            if self._poller is None and not self._closed:
+                self._poller = threading.Thread(
+                    target=self._poll_loop, daemon=True,
+                    name=f"serve-poll-{self.deployment_name}",
+                )
+                self._poller.start()
+        if force or not self._replicas:
+            import ray_tpu
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            r = ray_tpu.get(
+                controller.poll_replica_names.remote(
+                    self.deployment_name, -1, 0.0
+                ),
+                timeout=30,
+            )
+            self._apply_names(r["names"], r["version"])
+
+    def _pick(self) -> tuple:
+        """Power-of-two-choices with queue-length probes: two random
+        candidates report their actual in-flight depth (reference:
+        pow_2_scheduler.py:49); handle-local counts break probe failures
+        and ties. Multiplexed requests get deterministic model→replica
+        affinity so each model's weights stay warm on one replica."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"no replicas for deployment '{self.deployment_name}'"
+                )
+            if n == 1:
+                cand = [0]
+            elif self._model_id:
+                import zlib
+
+                cand = [zlib.crc32(self._model_id.encode()) % n]
+            else:
+                cand = random.sample(range(n), 2)
+            cand_named = [
+                (i, self._replica_names[i], self._replicas[i]) for i in cand
+            ]
+        if len(cand_named) == 1:
+            idx, name, replica = cand_named[0]
+        else:
+            import ray_tpu
+
+            # probe candidates INDEPENDENTLY: one dead/slow replica must
+            # neither discard the live candidate's answer nor stall the
+            # request past the probe budget — an unanswered or failed
+            # probe falls back to the local count, and a probe that
+            # ERRORS (replica dead) is penalized so the live one wins
+            refs = [r.queue_len.remote() for _, _, r in cand_named]
+            try:
+                ready, _ = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=_PROBE_TIMEOUT_S
+                )
+                ready_set = set(ready)
+            except Exception:
+                ready_set = set()
+            depths = []
+            for ref, (_i, nm, _r) in zip(refs, cand_named):
+                if ref in ready_set:
+                    try:
+                        depths.append(ray_tpu.get(ref, timeout=1))
+                        continue
+                    except Exception:
+                        depths.append(1 << 30)  # dead replica: avoid
+                        continue
+                with self._lock:
+                    depths.append(self._inflight.get(nm, 0))
+            pick = min(range(len(cand_named)), key=lambda i: depths[i])
+            idx, name, replica = cand_named[pick]
+        with self._lock:
+            self._inflight[name] = self._inflight.get(name, 0) + 1
+        return name, replica
+
+    def _done(self, name: str):
+        with self._lock:
+            if self._inflight.get(name, 0) > 0:
+                self._inflight[name] -= 1
+
+    def close(self):
+        self._closed = True
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        deadline = time.time() + 60
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                self._refresh_replicas()
+                idx, replica = self._pick()
+            except Exception as e:
+                last_err = e
+                time.sleep(0.25)
+                continue
+            try:
+                if self._model_id:
+                    kwargs = {**kwargs,
+                              "__multiplexed_model_id": self._model_id}
+                if self._stream:
+                    import ray_tpu
+
+                    sid = ray_tpu.get(
+                        replica.start_stream.remote(
+                            self._method, args, kwargs),
+                        timeout=60,
+                    )
+                    return StreamingResponse(replica, sid, self, idx)
+                ref = replica.handle_request.remote(
+                    self._method, args, kwargs
+                )
+                # decrement when the call resolves (best effort, piggybacks
+                # on the ref's completion via a daemon thread-free path: the
+                # response object decrements on result()).
+                resp = DeploymentResponse(ref)
+                _attach_done(resp, self, idx)
+                return resp
+            except Exception as e:
+                last_err = e
+                # the pick's in-flight increment must not outlive a failed
+                # dispatch (counts persist across set refreshes now)
+                self._done(idx)
+                self._refresh_replicas(force=True)
+        raise RuntimeError(
+            f"could not reach any replica of '{self.deployment_name}': {last_err}"
+        )
+
+
+def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int):
+    original = resp.result
+    done = {"fired": False}
+
+    def result(timeout: Optional[float] = None):
+        try:
+            return original(timeout)
+        finally:
+            if not done["fired"]:
+                done["fired"] = True
+                handle._done(idx)
+
+    resp.result = result

@@ -1,0 +1,234 @@
+"""HTTP proxy: routes requests to deployment handles.
+
+Counterpart of the reference's ProxyActor
+(reference: python/ray/serve/_private/proxy.py:1130 — per-node HTTP
+ingress; uvicorn there, a dependency-free asyncio HTTP/1.1 listener here).
+Routing: longest matching route_prefix wins
+(reference: proxy_router.py). Bodies are passed to the ingress deployment:
+JSON bodies decode to Python values, anything else arrives as bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional, Tuple
+
+logger = logging.getLogger("ray_tpu.serve.proxy")
+
+
+class ProxyActor:
+    _ROUTE_TTL_S = 1.0
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._port = 0
+        self._routes: dict = {}  # app name -> info
+        self._routes_at = 0.0
+        self._handles: dict = {}  # ingress name -> DeploymentHandle
+        # Dedicated pool: the default loop executor caps at ~min(32, cpus+4)
+        # threads, which would head-of-line-block cheap requests (and route
+        # refreshes) behind slow ones.
+        self._pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="proxy")
+        # Streams block a thread between item pulls (up to the whole
+        # response lifetime): give them their own pool so slow streams can
+        # never starve routing/non-streaming traffic out of self._pool.
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="proxy-stream")
+        self._stream_handles: dict = {}  # ingress name -> streaming handle
+
+    async def start(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, "127.0.0.1", port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        logger.info("serve proxy listening on %d", self._port)
+        return self._port
+
+    async def _route(self, path: str):
+        """Longest route_prefix match. The route table refreshes on a short
+        TTL and handles are cached per ingress, so the p2c router's
+        in-flight view survives across requests (a fresh handle per request
+        would degenerate to uniform random and pay three control-plane
+        round-trips on every call)."""
+        import ray_tpu
+        from ray_tpu.serve._handle import CONTROLLER_NAME, DeploymentHandle
+
+        import time as _time
+
+        now = _time.time()
+        if now - self._routes_at > self._ROUTE_TTL_S:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            loop = asyncio.get_running_loop()
+            self._routes = await loop.run_in_executor(
+                self._pool,
+                lambda: ray_tpu.get(controller.list_apps.remote(), timeout=10),
+            )
+            self._routes_at = now
+        best: Tuple[int, Optional[str]] = (-1, None)
+        for name, info in self._routes.items():
+            prefix = info.get("route_prefix")
+            if prefix is None:
+                continue
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or norm == "":
+                if len(norm) > best[0]:
+                    best = (len(norm), info["ingress"])
+        if best[1] is None:
+            return None
+        handle = self._handles.get(best[1])
+        if handle is None:
+            handle = self._handles[best[1]] = DeploymentHandle(best[1])
+        return handle
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1]
+            headers = {}
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1").partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            length = int(headers.get("content-length", 0) or 0)
+            if length:
+                body = await reader.readexactly(length)
+
+            raw_path, _, query = path.partition("?")
+            handle = await self._route(raw_path)
+            if handle is None:
+                await self._respond(writer, 404, b'{"error": "no route"}')
+                return
+            arg: object = body
+            ctype = headers.get("content-type", "")
+            if body and ("application/json" in ctype or not ctype):
+                try:
+                    arg = json.loads(body)
+                except Exception:
+                    arg = body
+            loop = asyncio.get_running_loop()
+
+            # ?stream=1 → chunked transfer, one chunk per generator item
+            # (reference: serve streaming responses over HTTP, proxy.py).
+            # Exact param match: substring matching would catch ?upstream=1.
+            if "stream=1" in query.split("&"):
+                await self._stream_response(
+                    writer, loop, handle, method, body, arg
+                )
+                return
+
+            def _call():
+                if method == "GET" and not body:
+                    resp = handle.remote()
+                else:
+                    resp = handle.remote(arg)
+                return resp.result(timeout=60)
+
+            try:
+                result = await loop.run_in_executor(self._pool, _call)
+            except Exception as e:
+                await self._respond(
+                    writer, 500, json.dumps({"error": str(e)}).encode()
+                )
+                return
+            if isinstance(result, (bytes, bytearray)):
+                out = bytes(result)
+                ctype_out = "application/octet-stream"
+            elif isinstance(result, str):
+                out = result.encode()
+                ctype_out = "text/plain; charset=utf-8"
+            else:
+                out = json.dumps(result).encode()
+                ctype_out = "application/json"
+            await self._respond(writer, 200, out, ctype_out)
+        except Exception:
+            logger.exception("proxy request failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _stream_response(self, writer, loop, handle, method, body, arg):
+        """HTTP chunked transfer: each generator item becomes one chunk
+        (newline-delimited; JSON for non-str/bytes items). The first item is
+        pulled BEFORE committing the status line, so an immediately-failing
+        generator still gets a 500 like the non-streaming path."""
+        # cached per ingress: a fresh handle per request would re-fetch
+        # replicas from the controller and reset the p2c in-flight view
+        h = self._stream_handles.get(handle.deployment_name)
+        if h is None:
+            h = handle.options(stream=True)
+            self._stream_handles[handle.deployment_name] = h
+
+        _END = object()
+        state = {}
+
+        def _start_and_first():
+            stream = (h.remote() if (method == "GET" and not body)
+                      else h.remote(arg))
+            state["stream"] = stream
+            try:
+                return next(stream)
+            except StopIteration:
+                return _END
+
+        def _next():
+            try:
+                return next(state["stream"])
+            except StopIteration:
+                return _END
+
+        try:
+            item = await loop.run_in_executor(
+                self._stream_pool, _start_and_first)
+        except Exception as e:
+            await self._respond(
+                writer, 500, json.dumps({"error": str(e)}).encode())
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/plain; charset=utf-8\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n"
+        )
+        await writer.drain()
+        try:
+            while item is not _END:
+                if isinstance(item, (bytes, bytearray)):
+                    data = bytes(item)
+                elif isinstance(item, str):
+                    data = item.encode()
+                else:
+                    data = json.dumps(item).encode()
+                data += b"\n"
+                writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                await writer.drain()
+                item = await loop.run_in_executor(self._stream_pool, _next)
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except Exception:
+            logger.exception("streaming response failed")
+            try:
+                state["stream"].close()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _respond(writer, status: int, body: bytes, ctype="application/json"):
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error"}.get(
+            status, "OK"
+        )
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n".encode() + body
+        )
+        await writer.drain()

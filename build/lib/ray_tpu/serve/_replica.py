@@ -1,0 +1,288 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Counterpart of the reference's ReplicaActor
+(reference: python/ray/serve/_private/replica.py:231 — wraps the user
+callable, enforces max_ongoing_requests, exposes queue length for the
+router and health checks for the controller).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+
+class Replica:
+    """Instantiated inside a dedicated (async, max_concurrency) actor."""
+
+    def __init__(self, serialized: dict, init_args: tuple, init_kwargs: dict):
+        import cloudpickle
+
+        from ray_tpu.serve._deployment import _HandleRef
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        func_or_class = cloudpickle.loads(serialized["callable"])
+        self._name = serialized["name"]
+        init_args = tuple(
+            DeploymentHandle(a.deployment_name) if isinstance(a, _HandleRef) else a
+            for a in init_args
+        )
+        init_kwargs = {
+            k: DeploymentHandle(v.deployment_name) if isinstance(v, _HandleRef) else v
+            for k, v in init_kwargs.items()
+        }
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self._callable = func_or_class
+            self._is_function = True
+        self._ongoing = 0
+        self._handled = 0
+        # User-request concurrency is self-gated so the actor's
+        # max_concurrency can carry headroom for control-plane methods
+        # (queue_len probes, metrics) — a saturated replica must still
+        # answer probes instantly (reference: pow_2_scheduler probes).
+        self._max_ongoing = serialized.get("max_ongoing", 8)
+        self._sem = None  # lazy: created on the actor loop
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
+        import asyncio
+        import functools
+
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self._max_ongoing)
+        model_id = kwargs.pop("__multiplexed_model_id", "")
+        if model_id:
+            from ray_tpu.serve.multiplex import _set_current_model_id
+
+            _set_current_model_id(model_id)
+        # _ongoing counts queued + running: the probe's notion of depth
+        self._ongoing += 1
+        try:
+            await self._sem.acquire()
+        except BaseException:
+            self._ongoing -= 1
+            raise
+        try:
+            if self._is_function:
+                target = self._callable
+            else:
+                target = getattr(self._callable, method or "__call__")
+            if inspect.iscoroutinefunction(target) or getattr(
+                target, "_is_serve_batch", False
+            ):
+                return await target(*args, **kwargs)
+            # Sync callables run in the thread pool so max_ongoing_requests
+            # gives real concurrency and metadata/health stay responsive
+            # (reference: replica.py runs sync user methods off-loop). The
+            # request context (multiplexed model id) is copied into the
+            # worker thread explicitly — run_in_executor does not.
+            import contextvars
+
+            loop = asyncio.get_running_loop()
+            ctx = contextvars.copy_context()
+            result = await loop.run_in_executor(
+                None, ctx.run, functools.partial(target, *args, **kwargs)
+            )
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._sem.release()
+            self._ongoing -= 1
+            self._handled += 1
+
+    # ------------------------------------------------------------ streaming
+
+    async def start_stream(self, method: str, args: tuple, kwargs: dict) -> str:
+        """Begin a streaming call: the target returns a (sync or async)
+        generator; items are pulled in batches via next_stream_items
+        (reference: serve's streaming responses, replica.py generator
+        handling)."""
+        import asyncio
+        import uuid
+
+        import time as _time
+
+        # streams count against max_ongoing_requests for their whole
+        # lifetime (slot released in _drop_stream) — the actor-level
+        # concurrency cap no longer enforces this since it carries probe
+        # headroom
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self._max_ongoing)
+        self._ongoing += 1
+        try:
+            await self._sem.acquire()
+        except BaseException:
+            self._ongoing -= 1
+            raise
+        try:
+            model_id = kwargs.pop("__multiplexed_model_id", "")
+            if model_id:
+                from ray_tpu.serve.multiplex import _set_current_model_id
+
+                _set_current_model_id(model_id)
+            target = (self._callable if self._is_function
+                      else getattr(self._callable, method or "__call__"))
+            gen = target(*args, **kwargs)
+            if inspect.iscoroutine(gen):
+                gen = await gen
+            sid = uuid.uuid4().hex
+            if not hasattr(self, "_streams"):
+                self._streams = {}
+            # model_id stored with the stream: the generator body executes
+            # in next_stream_items' task context, not this one
+            self._streams[sid] = {"gen": gen, "model_id": model_id,
+                                  "last_pull": _time.time()}
+            return sid
+        except BaseException:
+            self._sem.release()
+            self._ongoing -= 1
+            raise
+
+    def _release_slot(self):
+        if self._sem is not None:
+            self._sem.release()
+
+    async def cancel_stream(self, stream_id: str):
+        """Client-side abandonment (StreamingResponse.close/__del__)."""
+        self._drop_stream(stream_id)
+        return True
+
+    def _drop_stream(self, stream_id: str):
+        rec = getattr(self, "_streams", {}).pop(stream_id, None)
+        if rec is not None:
+            self._release_slot()
+            self._ongoing -= 1
+            self._handled += 1
+
+    def _reap_idle_streams(self, max_idle_s: float = 300.0):
+        """Abandoned streams (client died mid-iteration) must not pin
+        _ongoing/memory forever; called from the metrics push loop."""
+        import time as _time
+
+        now = _time.time()
+        for sid, rec in list(getattr(self, "_streams", {}).items()):
+            if now - rec["last_pull"] > max_idle_s:
+                self._drop_stream(sid)
+
+    async def next_stream_items(self, stream_id: str,
+                                max_items: int = 16) -> dict:
+        """Pull up to max_items from the stream; done=True ends it."""
+        import time as _time
+
+        rec = getattr(self, "_streams", {}).get(stream_id)
+        if rec is None:
+            return {"items": [], "done": True}
+        rec["last_pull"] = _time.time()
+        gen = rec["gen"]
+        if rec["model_id"]:
+            from ray_tpu.serve.multiplex import _set_current_model_id
+
+            _set_current_model_id(rec["model_id"])
+        items = []
+        done = False
+        try:
+            if inspect.isasyncgen(gen):
+                for _ in range(max_items):
+                    try:
+                        items.append(await gen.__anext__())
+                    except StopAsyncIteration:
+                        done = True
+                        break
+            else:
+                import asyncio as _asyncio
+                import contextvars as _cv
+                import functools as _functools
+
+                def pull():
+                    out = []
+                    for _ in range(max_items):
+                        try:
+                            out.append(next(gen))
+                        except StopIteration:
+                            return out, True
+                    return out, False
+
+                loop = _asyncio.get_running_loop()
+                ctx = _cv.copy_context()  # carries the model id
+                items, done = await loop.run_in_executor(
+                    None, ctx.run, _functools.partial(pull))
+        except Exception:
+            self._drop_stream(stream_id)
+            raise
+        if done:
+            self._drop_stream(stream_id)
+        return {"items": items, "done": done}
+
+    def get_metadata(self) -> dict:
+        return {"ongoing": self._ongoing, "handled": self._handled}
+
+    async def queue_len(self) -> int:
+        """Current in-flight count, probed by pow-2 routing (reference:
+        replica_scheduler/pow_2_scheduler.py:49 queue-length probes)."""
+        return self._ongoing
+
+    async def start_metrics_push(
+        self, replica_name: str, health_check_period_s: float = 2.0
+    ):
+        """Controller calls this once after creation: push ongoing-request
+        stats every 0.5s (reference: replicas push autoscaling metrics to
+        the controller, serve/_private/autoscaling_state.py — a pull would
+        queue FIFO behind user requests and always observe a drained
+        queue). The user's check_health() runs on its own period and rides
+        the same push: a failing check marks the replica unhealthy and the
+        controller replaces it."""
+        import asyncio
+        import time as _time
+
+        if getattr(self, "_push_task", None) is not None:
+            return
+        self._replica_name = replica_name
+
+        async def _loop():
+            import ray_tpu
+            from ray_tpu.serve._handle import CONTROLLER_NAME
+
+            controller = None
+            healthy = True
+            last_health_check = 0.0
+            while True:
+                now = _time.time()
+                try:
+                    self._reap_idle_streams()
+                except Exception:
+                    pass
+                if now - last_health_check >= health_check_period_s:
+                    last_health_check = now
+                    try:
+                        await self.check_health()
+                        healthy = True
+                    except Exception:
+                        healthy = False
+                try:
+                    if controller is None:
+                        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    controller.report_replica_metrics.remote(
+                        self._name,
+                        replica_name,
+                        {
+                            "ongoing": self._ongoing,
+                            "handled": self._handled,
+                            "healthy": healthy,
+                        },
+                    )
+                except Exception:
+                    controller = None
+                await asyncio.sleep(0.5)
+
+        self._push_task = asyncio.ensure_future(_loop())
+
+    async def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if user_check is not None:
+            result = user_check()
+            if inspect.iscoroutine(result):
+                await result
+        return True

@@ -1,0 +1,92 @@
+"""@serve.batch — transparent request batching inside a replica.
+
+Counterpart of the reference's batching (reference:
+python/ray/serve/batching.py — queue individual calls, run the wrapped
+method once per batch of up to max_batch_size after at most
+batch_wait_timeout_s, scatter results back).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.queue: List[tuple] = []  # (item, future)
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def submit(self, instance, item) -> Any:
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append((item, fut))
+        if len(self.queue) >= self.max_batch_size:
+            await self._flush(instance)
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.ensure_future(self._delayed_flush(instance))
+        return await fut
+
+    async def _delayed_flush(self, instance):
+        await asyncio.sleep(self.batch_wait_timeout_s)
+        await self._flush(instance)
+
+    async def _flush(self, instance):
+        if not self.queue:
+            return
+        batch, self.queue = self.queue, []
+        items = [b[0] for b in batch]
+        try:
+            if instance is not None:
+                results = await self.fn(instance, items)
+            else:
+                results = await self.fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for a batch of {len(items)}"
+                )
+            for (_, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+):
+    """Decorate an async method taking a LIST of items; individual calls
+    are queued and executed as batches."""
+
+    def wrap(fn):
+        queues = {}  # instance id -> _BatchQueue (per-replica state)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                instance, item = args
+            elif len(args) == 1:
+                instance, item = None, args[0]
+            else:
+                raise TypeError("@serve.batch methods take exactly one argument")
+            key = id(instance)
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(instance, item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

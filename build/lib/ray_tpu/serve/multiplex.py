@@ -1,0 +1,101 @@
+"""Model multiplexing: many models served by few replicas
+(reference: python/ray/serve/multiplex.py — @serve.multiplexed LRU model
+cache per replica + serve.get_multiplexed_model_id()).
+
+The handle routes a request tagged with ``multiplexed_model_id`` to a
+replica with deterministic model→replica affinity (hash-based), so a model's
+weights load on one replica instead of all of them; inside the replica a
+@multiplexed-decorated loader keeps an LRU cache of at most
+``max_num_models_per_replica`` models, evicting the least-recently-used
+(calling its ``__del__`` if defined, mirroring the reference's unload hook).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rtpu_serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request currently being handled
+    (reference: serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    _current_model_id.set(model_id)
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, max_models: int):
+        self._loader = loader
+        self._max = max_models
+        self._models: OrderedDict[str, Any] = OrderedDict()
+        self._locks: dict = {}
+
+    async def get(self, owner, model_id: str) -> Any:
+        if model_id in self._models:
+            self._models.move_to_end(model_id)
+            return self._models[model_id]
+        lock = self._locks.setdefault(model_id, asyncio.Lock())
+        async with lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+            result = self._loader(owner, model_id) if owner is not None \
+                else self._loader(model_id)
+            if inspect.iscoroutine(result):
+                result = await result
+            self._models[model_id] = result
+            while len(self._models) > self._max:
+                _, evicted = self._models.popitem(last=False)
+                del_fn = getattr(evicted, "__del__", None)
+                if del_fn is not None:
+                    try:
+                        r = del_fn()
+                        if inspect.iscoroutine(r):
+                            await r
+                    except Exception:
+                        pass
+            return result
+
+    def loaded_ids(self):
+        return list(self._models.keys())
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for a model-loader method/function:
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str): ...
+
+    Calls are LRU-cached per replica by model id."""
+
+    def wrap(fn):
+        sig = inspect.signature(fn)
+        takes_self = list(sig.parameters) and (
+            list(sig.parameters)[0] == "self")
+        cache = _ModelCache(fn, max_num_models_per_replica)
+
+        if takes_self:
+            async def wrapper(self, model_id: str):
+                return await cache.get(self, model_id)
+        else:
+            async def wrapper(model_id: str):
+                return await cache.get(None, model_id)
+
+        wrapper._serve_model_cache = cache
+        wrapper.__name__ = getattr(fn, "__name__", "multiplexed")
+        return wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -1,0 +1,45 @@
+"""ray_tpu.train — SPMD training over actor worker groups, jax-first.
+
+Counterpart of Ray Train (reference: python/ray/train/, call stack SURVEY.md
+§3.4) with the torch/NCCL data plane replaced by jax: one worker actor per
+host, `jax.distributed` coordination, a global device mesh over ICI, and the
+sharded train step compiled by XLA (ray_tpu/parallel/train_step.py).
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._config import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train._trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TrainingFailedError,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

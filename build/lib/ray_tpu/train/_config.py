@@ -1,0 +1,72 @@
+"""Train/AIR configuration dataclasses (reference: python/ray/air/config.py —
+ScalingConfig :103, RunConfig :594, FailureConfig :395, CheckpointConfig :445;
+train/torch/config.py for the backend config notion)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one owns.
+
+    TPU-first: `topology` names a slice type (e.g. "v5e-8"); a worker then
+    requests that slice's head resource so exactly one worker lands per slice
+    (reference accelerator manager: _private/accelerators/tpu.py:362-381).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: str = ""
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.topology:
+            return {f"TPU-{self.topology}-head": 1}
+        if self.use_tpu:
+            return {"TPU": 1}
+        return {"CPU": 1}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Group-restart fault tolerance: on worker failure the whole group
+    restarts from the last checkpoint (reference: air/config.py:395; no
+    elastic resize, same as the reference)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+
+
+@dataclasses.dataclass
+class JaxConfig:
+    """Backend config (reference analogue: TorchConfig train/torch/config.py:65
+    — but instead of dist.init_process_group, workers run
+    jax.distributed.initialize against rank 0's coordinator)."""
+
+    # Initialize jax.distributed across workers (multi-host mesh). With one
+    # worker the local process sees its chips directly and this is skipped.
+    distributed: Optional[bool] = None
+    # Env vars applied in each worker before jax initializes (e.g. forcing
+    # JAX_PLATFORMS=cpu + a virtual device count in chip-free tests).
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    coordinator_port: int = 0  # 0: pick a free port

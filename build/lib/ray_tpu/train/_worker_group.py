@@ -1,0 +1,256 @@
+"""Worker group: N long-lived actors, one per (host, slice), gang-scheduled
+via a placement group (reference: train/_internal/worker_group.py:102 +
+backend_executor.py:67). The driver never holds device arrays — each worker is
+its own jax process (multi-controller SPMD), which is how jax wants to scale."""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._session import (
+    TrainContext,
+    get_session,
+    init_session,
+    shutdown_session,
+)
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+logger = logging.getLogger("ray_tpu.train")
+
+
+def _to_actor_options(res: Dict[str, float]) -> Dict[str, Any]:
+    """Split a bundle-style resources dict into actor options (CPU/TPU/memory
+    use dedicated options; the rest ride the custom-resources dict)."""
+    res = dict(res)
+    return {
+        "num_cpus": res.pop("CPU", 0),
+        "num_tpus": res.pop("TPU", 0),
+        "memory": res.pop("memory", 0),
+        "resources": res,
+    }
+
+
+class _TrainWorker:
+    """Actor hosting one training process (one jax process per worker)."""
+
+    def __init__(self, rank: int, env: Dict[str, str]):
+        import sys
+
+        for k, v in env.items():
+            os.environ[k] = str(v)
+        # The fork server preimports the runtime, which pulls in jax — its
+        # import-time config snapshot predates our env vars. The backend is
+        # still uninitialized here (nothing touched a device), so pushing the
+        # platform through jax.config makes the env effective anyway;
+        # XLA_FLAGS / TPU_VISIBLE_CHIPS are read at backend init and work
+        # as plain env vars.
+        if "jax" in sys.modules and "JAX_PLATFORMS" in env:
+            import jax
+
+            jax.config.update("jax_platforms", env["JAX_PLATFORMS"] or None)
+        self._rank = rank
+        self._thread: Optional[threading.Thread] = None
+
+    def node_ip(self) -> str:
+        return socket.gethostbyname(socket.gethostname())
+
+    def free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def init_jax_distributed(self, coordinator: str, num_processes: int,
+                             process_id: int):
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return len(jax.devices())
+
+    def init_torch_process_group(self, master_ip: str, master_port: int,
+                                 world_size: int, rank: int,
+                                 backend: str = "gloo",
+                                 timeout_s: float = 120.0):
+        """torch.distributed bootstrap (reference: train/torch/config.py:65
+        _setup_torch_process_group — MASTER_ADDR/PORT + init_process_group)."""
+        import datetime
+
+        import torch.distributed as dist
+
+        os.environ["MASTER_ADDR"] = master_ip
+        os.environ["MASTER_PORT"] = str(master_port)
+        dist.init_process_group(
+            backend=backend,
+            init_method=f"tcp://{master_ip}:{master_port}",
+            world_size=world_size,
+            rank=rank,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+        return dist.get_rank()
+
+    def start_run(
+        self,
+        train_fn: Callable,
+        config: Optional[dict],
+        ctx: TrainContext,
+        checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        pipeline_depth: int = 1,
+    ):
+        session = init_session(ctx, checkpoint, dataset_shards, pipeline_depth)
+
+        import inspect
+
+        try:
+            takes_config = len(inspect.signature(train_fn).parameters) > 0
+        except (TypeError, ValueError):
+            takes_config = True
+
+        def runner():
+            try:
+                if takes_config:
+                    train_fn(config if config is not None else {})
+                else:
+                    train_fn()
+            except BaseException as e:  # noqa: BLE001 — reported to driver
+                session.error = e
+                session.error_tb = traceback.format_exc()
+            finally:
+                session.finished = True
+                # wake any blocked report consumer hand-off
+                session.reports.put(None)
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+        return True
+
+    def _report_to_wire(self, item) -> dict:
+        session = get_session()
+        if item is None:
+            if session.error is not None:
+                return {
+                    "type": "error",
+                    "error": str(session.error),
+                    "traceback": getattr(session, "error_tb", ""),
+                }
+            return {"type": "finished"}
+        out = {"type": "report", "metrics": item["metrics"]}
+        ckpt = item["checkpoint"]
+        if ckpt is not None:
+            out["checkpoint_path"] = ckpt.path
+        return out
+
+    def next_report(self) -> dict:
+        """Block until the worker's loop reports, errors, or finishes."""
+        return self._report_to_wire(get_session().reports.get())
+
+    def drain_reports(self, ack: int = 0) -> List[dict]:
+        """Non-blocking batched drain with piggybacked acks — the Train
+        driver's consumption path. Crucially there is NO thread parked on
+        the report queue: report() is then a bare deque append, so the
+        training thread's jax dispatch is never preempted by report-handler
+        wakeups (at ~2ms TPU steps, per-report GIL handoffs measured ~3.6
+        ms/step). The driver polls at 20Hz; Tune keeps the blocking
+        per-report next_report so schedulers decide on every round."""
+        import queue as _q
+
+        session = get_session()
+        if ack:
+            session.ack(ack)
+        items = []
+        while True:
+            try:
+                items.append(session.reports.get_nowait())
+            except _q.Empty:
+                break
+            if items[-1] is None:
+                break
+        return [self._report_to_wire(i) for i in items]
+
+    def ack_report(self, n: int = 1):
+        session = get_session()
+        if session is not None:
+            session.ack(n)
+        return True
+
+    def upload_checkpoint(self, local_path: str, experiment_uri: str,
+                          rel: str) -> str:
+        """Upload this worker's checkpoint dir into experiment storage from
+        the worker's own node (reference: StorageContext uploads happen
+        worker-side, train/_internal/storage.py:352 — the driver never
+        touches worker-local paths)."""
+        from ray_tpu.train._storage import get_storage
+
+        return get_storage(experiment_uri).upload_dir(local_path, rel)
+
+    def finish(self):
+        shutdown_session()
+        return True
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+        env: Optional[Dict[str, str]] = None,
+    ):
+        self.num_workers = num_workers
+        self._pg = placement_group(
+            [dict(resources_per_worker)] * num_workers,
+            strategy=placement_strategy,
+        )
+        if not self._pg.wait(120):
+            remove_placement_group(self._pg)
+            raise RuntimeError(
+                f"could not reserve {num_workers} x {resources_per_worker} "
+                "for the train worker group"
+            )
+        actor_cls = ray_tpu.remote(_TrainWorker)
+        opts = _to_actor_options(resources_per_worker)
+        self.workers = [
+            actor_cls.options(
+                **opts,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(self._pg, i),
+            ).remote(i, env or {})
+            for i in range(num_workers)
+        ]
+
+    def execute(self, method: str, *args, per_worker_args: Optional[List[tuple]] = None,
+                timeout: Optional[float] = None) -> List[Any]:
+        refs = []
+        for i, w in enumerate(self.workers):
+            call_args = per_worker_args[i] if per_worker_args is not None else args
+            refs.append(getattr(w, method).remote(*call_args))
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def execute_single(self, i: int, method: str, *args) -> Any:
+        return ray_tpu.get(getattr(self.workers[i], method).remote(*args))
+
+    def async_call(self, i: int, method: str, *args):
+        return getattr(self.workers[i], method).remote(*args)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self._pg)
+        except Exception:
+            pass

@@ -1,0 +1,114 @@
+"""TorchTrainer: data-parallel torch training over the actor worker group
+(reference: python/ray/train/torch/torch_trainer.py:11 + config.py:65
+_setup_torch_process_group + train_loop_utils.py:453 prepare_model / :313
+prepare_data_loader).
+
+The jax path is this framework's flagship (JaxTrainer); TorchTrainer exists
+for API parity with the reference's most-used trainer. Workers form a
+torch.distributed gloo process group (CPU boxes; NCCL is a GPU concern the
+TPU stack doesn't carry), DDP averages gradients, and the session
+report/checkpoint machinery is shared with every other trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ray_tpu.train._trainer import DataParallelTrainer, logger
+
+
+@dataclasses.dataclass
+class TorchConfig:
+    """Process-group config (reference: train/torch/config.py:65)."""
+
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Runs `train_loop_per_worker` on every worker inside one
+    torch.distributed process group."""
+
+    def __init__(self, *args, torch_config: Optional[TorchConfig] = None, **kw):
+        super().__init__(*args, **kw)
+        self.torch_config = torch_config or TorchConfig()
+
+    def _worker_env(self) -> Dict[str, str]:
+        # gloo rendezvous env is set per-worker in _on_group_start
+        return {}
+
+    def _on_group_start(self, group):
+        if group.num_workers <= 1:
+            return
+        ip = group.execute_single(0, "node_ip")
+        port = group.execute_single(0, "free_port")
+        import ray_tpu
+
+        refs = [
+            group.async_call(
+                i, "init_torch_process_group",
+                ip, port, group.num_workers, i,
+                self.torch_config.backend,
+                self.torch_config.init_timeout_s,
+            )
+            for i in range(group.num_workers)
+        ]
+        ray_tpu.get(refs, timeout=self.torch_config.init_timeout_s + 60)
+        logger.info("torch.distributed(%s) up: %d ranks",
+                    self.torch_config.backend, group.num_workers)
+
+
+# ------------------------------------------------------- worker-side helpers
+
+
+def get_device():
+    """The device this worker should use (reference:
+    train/torch/train_loop_utils.py get_device). CPU here — TPU math goes
+    through the jax path."""
+    import torch
+
+    return torch.device("cpu")
+
+
+def prepare_model(model):
+    """Wrap the model for distributed training (reference:
+    train_loop_utils.py:453 — DDP when world_size > 1)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across workers with a DistributedSampler
+    (reference: train_loop_utils.py:313). Preserves the loader's shuffle
+    setting; loaders built with a custom batch_sampler can't be resharded
+    automatically and are rejected."""
+    import torch.distributed as dist
+
+    if not dist.is_initialized() or dist.get_world_size() <= 1:
+        return loader
+    import torch.utils.data as tud
+
+    if loader.batch_size is None:
+        raise ValueError(
+            "prepare_data_loader cannot reshard a DataLoader built with a "
+            "custom batch_sampler; construct a DistributedSampler-aware "
+            "batch_sampler yourself"
+        )
+    shuffle = isinstance(loader.sampler, tud.RandomSampler)
+    sampler = tud.distributed.DistributedSampler(
+        loader.dataset, shuffle=shuffle
+    )
+    return tud.DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+    )

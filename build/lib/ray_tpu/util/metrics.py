@@ -1,0 +1,152 @@
+"""User-defined application metrics: Counter, Gauge, Histogram.
+
+Counterpart of ``ray.util.metrics`` (reference: python/ray/util/metrics.py:19).
+Metric updates are recorded in-process and pushed to the GCS with the
+periodic task-event flush; the GCS aggregates them (summing counters,
+last-write gauges, bucket-merging histograms) and exports everything on its
+Prometheus /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_lock = threading.Lock()
+# (name, frozenset(label items)) -> record dict
+_records: Dict[Tuple[str, frozenset], dict] = {}
+
+
+def _record(kind: str, name: str, help_: str, labels: Dict[str, str], **kw):
+    key = (name, frozenset(labels.items()))
+    with _lock:
+        rec = _records.get(key)
+        if rec is None:
+            rec = {
+                "kind": kind,
+                "name": name,
+                "help": help_,
+                "labels": dict(labels),
+                "value": 0.0,
+                "buckets": {},  # boundary -> count (histogram)
+                "count": 0,
+                "sum": 0.0,
+            }
+            _records[key] = rec
+        return rec
+
+
+def drain_records() -> List[dict]:
+    """Called by the worker's flush loop; returns a snapshot (counters and
+    histograms are cumulative deltas since the last drain)."""
+    with _lock:
+        out = []
+        for rec in _records.values():
+            snap = {k: (dict(v) if isinstance(v, dict) else v) for k, v in rec.items()}
+            out.append(snap)
+            if rec["kind"] in ("counter", "histogram"):
+                rec["value"] = 0.0
+                rec["buckets"] = {}
+                rec["count"] = 0
+                rec["sum"] = 0.0
+        return [s for s in out if s["kind"] == "gauge" or s["count"] or s["value"]]
+
+
+def restore_records(records: List[dict]) -> None:
+    """Re-merge drained deltas after a failed flush so counter/histogram
+    increments survive a GCS outage instead of being silently lost."""
+    with _lock:
+        for snap in records:
+            # The flush stamps WorkerId/JobId; strip them to match local keys.
+            labels = {
+                k: v
+                for k, v in snap.get("labels", {}).items()
+                if k not in ("WorkerId", "JobId")
+            }
+            key = (snap["name"], frozenset(labels.items()))
+            rec = _records.get(key)
+            if rec is None or rec["kind"] != snap["kind"]:
+                continue
+            if snap["kind"] in ("counter", "histogram"):
+                rec["value"] += snap.get("value", 0.0)
+                for b, c in snap.get("buckets", {}).items():
+                    rec["buckets"][b] = rec["buckets"].get(b, 0) + c
+                rec["count"] += snap.get("count", 0)
+                rec["sum"] += snap.get("sum", 0.0)
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(
+                f"tag(s) {sorted(extra)} not declared in tag_keys={self._tag_keys}"
+            )
+        return merged
+
+
+class Counter(_Metric):
+    """Monotonically increasing value (reference: util/metrics.py Counter)."""
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() requires value >= 0")
+        rec = _record("counter", self._name, self._description, self._tags(tags))
+        with _lock:
+            rec["value"] += value
+            rec["count"] += 1
+
+
+class Gauge(_Metric):
+    """Last-set value."""
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        rec = _record("gauge", self._name, self._description, self._tags(tags))
+        with _lock:
+            rec["value"] = float(value)
+            rec["count"] += 1
+
+
+class Histogram(_Metric):
+    """Bucketed observations."""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = (),
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires bucket boundaries")
+        self._boundaries = sorted(float(b) for b in boundaries)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        rec = _record("histogram", self._name, self._description, self._tags(tags))
+        with _lock:
+            rec.setdefault("boundaries", self._boundaries)
+            for b in self._boundaries:
+                if value <= b:
+                    key = str(b)
+                    break
+            else:
+                key = "+Inf"  # above the largest boundary
+            rec["buckets"][key] = rec["buckets"].get(key, 0) + 1
+            rec["count"] += 1
+            rec["sum"] += float(value)

@@ -1,0 +1,261 @@
+"""State API: live introspection of cluster entities.
+
+Counterpart of ``ray.util.state``
+(reference: python/ray/util/state/api.py — list_actors :781, list_nodes
+:873, list_tasks :1008, summarize_tasks :1365; aggregation
+dashboard/state_aggregator.py:138). The GCS is the source of truth for
+actors/nodes/jobs/placement groups/tasks (task events); object listings are
+aggregated live from every raylet's plasma + spill tables.
+
+All functions accept an optional ``address`` ("host:port" of the GCS);
+default is the connected driver's cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.gcs.client import GcsClient
+
+
+def _gcs(address: Optional[str]) -> GcsClient:
+    if address:
+        return GcsClient.from_address(address)
+    from ray_tpu._private import worker as worker_mod
+
+    if worker_mod.global_worker is None:
+        raise RuntimeError("ray_tpu is not initialized and no address given")
+    return worker_mod.global_worker.gcs
+
+
+def _hex(b) -> str:
+    return b.hex() if isinstance(b, (bytes, bytearray)) else str(b)
+
+
+def list_nodes(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
+    nodes = _gcs(address).call("GetAllNodeInfo", {})["nodes"]
+    out = [
+        {
+            "node_id": _hex(n["node_id"]),
+            "state": n["state"],
+            "node_ip": n["ip"],
+            "raylet_port": n["raylet_port"],
+            "metrics_port": n.get("metrics_port", 0),
+            "is_head_node": bool(n.get("is_head")),
+            "resources_total": n.get("resources_total", {}),
+            "resources_available": n.get("resources_available", {}),
+            "labels": n.get("labels", {}),
+            "start_time": n.get("start_time"),
+            "end_time": n.get("end_time"),
+        }
+        for n in nodes
+    ]
+    return _filtered(out, filters)[:limit]
+
+
+def list_actors(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
+    actors = _gcs(address).call("ListActors", {})["actors"]
+    out = [
+        {
+            "actor_id": _hex(a["actor_id"]),
+            "state": a["state"],
+            "name": a.get("name", ""),
+            "ray_namespace": a.get("namespace", ""),
+            "job_id": _hex(a.get("job_id", b"")),
+            "node_id": _hex(a["node_id"]) if a.get("node_id") else None,
+            "pid": None,
+            "class_name": a.get("class_name", ""),
+            "num_restarts": a.get("num_restarts", 0),
+            "death_cause": a.get("death_cause", ""),
+            "start_time": a.get("start_time"),
+        }
+        for a in actors
+    ]
+    return _filtered(out, filters)[:limit]
+
+
+def list_jobs(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
+    jobs = _gcs(address).call("GetAllJobInfo", {})["jobs"]
+    out = [
+        {
+            "job_id": _hex(j["job_id"]),
+            "status": j.get("state", ""),
+            "entrypoint": j.get("entrypoint", ""),
+            "start_time": j.get("start_time"),
+            "end_time": j.get("end_time"),
+            "metadata": j.get("metadata", {}),
+        }
+        for j in jobs
+    ]
+    return _filtered(out, filters)[:limit]
+
+
+def list_placement_groups(
+    address: Optional[str] = None, *, filters=None, limit: int = 10_000
+) -> List[dict]:
+    pgs = _gcs(address).call("ListPlacementGroups", {})["pgs"]
+    out = [
+        {
+            "placement_group_id": _hex(p["pg_id"]),
+            "name": p.get("name", ""),
+            "state": p["state"],
+            "strategy": p.get("strategy", ""),
+            "bundles": [
+                {
+                    "bundle_index": b["index"],
+                    "resources": b["resources"],
+                    "node_id": _hex(b["node_id"]) if b.get("node_id") else None,
+                }
+                for b in p.get("bundles", [])
+            ],
+        }
+        for p in pgs
+    ]
+    return _filtered(out, filters)[:limit]
+
+
+def list_tasks(
+    address: Optional[str] = None, *, filters=None, limit: int = 10_000
+) -> List[dict]:
+    """Latest known state per task, folded from the GCS task-event log."""
+    events = _gcs(address).call("GetTaskEvents", {"limit": 100_000})["events"]
+    latest: Dict[str, dict] = {}
+    first_ts: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("state") == "SPAN":
+            continue  # tracing spans share the sink but are not tasks
+        tid = ev["task_id"]
+        first_ts.setdefault(tid, ev["ts"])
+        cur = latest.get(tid)
+        if cur is None or ev["ts"] >= cur["ts"]:
+            latest[tid] = ev
+    out = [
+        {
+            "task_id": ev["task_id"],
+            "name": ev.get("name", ""),
+            "state": ev["state"],
+            "job_id": ev.get("job_id", ""),
+            "actor_id": ev.get("actor_id", "") or None,
+            "node_id": ev.get("node_id", ""),
+            "worker_id": ev.get("worker_id", ""),
+            "error_message": ev.get("error", ""),
+            "creation_time": first_ts[ev["task_id"]],
+            "last_update_time": ev["ts"],
+        }
+        for ev in latest.values()
+    ]
+    out.sort(key=lambda t: t["creation_time"])
+    return _filtered(out, filters)[:limit]
+
+
+def summarize_tasks(address: Optional[str] = None) -> dict:
+    """Counts by (name, state) — reference: util/state/api.py:1365."""
+    tasks = list_tasks(address)
+    summary: Dict[str, Dict[str, int]] = {}
+    for t in tasks:
+        by_state = summary.setdefault(t["name"], {})
+        by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+    return {
+        "total_tasks": len(tasks),
+        "summary": summary,
+    }
+
+
+def _fanout_raylets(address: Optional[str], method: str, timeout: float = 10.0):
+    """Call every alive raylet concurrently; yields (node, reply) pairs."""
+    import asyncio
+
+    from ray_tpu._private.rpc import IoThread, RpcClient
+
+    nodes = [
+        n
+        for n in _gcs(address).call("GetAllNodeInfo", {})["nodes"]
+        if n["state"] == "ALIVE"
+    ]
+
+    async def _one(n):
+        client = RpcClient(n["ip"], n["raylet_port"])
+        try:
+            await client.connect()
+            return n, await client.call(method, {}, timeout=timeout)
+        finally:
+            await client.close()
+
+    async def _all():
+        return await asyncio.gather(
+            *(_one(n) for n in nodes), return_exceptions=True
+        )
+
+    results = IoThread.current().run(_all(), timeout=timeout + 10)
+    return [r for r in results if not isinstance(r, BaseException)]
+
+
+def list_objects(
+    address: Optional[str] = None, *, filters=None, limit: int = 10_000
+) -> List[dict]:
+    """Aggregate plasma + spilled objects from every alive raylet."""
+    out: List[dict] = []
+    for n, r in _fanout_raylets(address, "GetLocalObjectInfo"):
+        for o in r.get("objects", []):
+            out.append(
+                {
+                    "object_id": _hex(o["object_id"]),
+                    "node_id": _hex(n["node_id"]),
+                    "size_bytes": o.get("size"),
+                    "pinned": o.get("pinned", False),
+                    "spilled": o.get("spilled", False),
+                }
+            )
+    return _filtered(out, filters)[:limit]
+
+
+def list_workers(
+    address: Optional[str] = None, *, filters=None, limit: int = 10_000
+) -> List[dict]:
+    """Live worker processes (from every raylet) + recent worker failures."""
+    out: List[dict] = []
+    for n, r in _fanout_raylets(address, "GetLocalWorkerInfo"):
+        for w in r.get("workers", []):
+            out.append(
+                {
+                    "worker_id": _hex(w.get("worker_id", b"")),
+                    "node_id": _hex(n["node_id"]),
+                    "pid": w.get("pid"),
+                    "job_id": _hex(w.get("job_id", b"")),
+                    "is_alive": bool(w.get("alive", True)),
+                    "leased": bool(w.get("leased")),
+                    "actor_id": _hex(w["actor_id"]) if w.get("actor_id") else None,
+                    "exit_detail": "",
+                    "end_time": None,
+                }
+            )
+    failures = _gcs(address).call("GetWorkerFailures", {"limit": limit})["failures"]
+    out.extend(
+        {
+            "worker_id": _hex(f.get("worker_id", b"")),
+            "node_id": _hex(f.get("node_id", b"")),
+            "pid": None,
+            "job_id": "",
+            "is_alive": False,
+            "leased": False,
+            "actor_id": None,
+            "exit_detail": f.get("reason", ""),
+            "end_time": f.get("time"),
+        }
+        for f in failures
+    )
+    return _filtered(out, filters)[:limit]
+
+
+def _filtered(rows: List[dict], filters) -> List[dict]:
+    """filters: iterable of (key, predicate '=' or '!=', value) tuples."""
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op == "=":
+            rows = [r for r in rows if r.get(key) == value]
+        elif op == "!=":
+            rows = [r for r in rows if r.get(key) != value]
+        else:
+            raise ValueError(f"unsupported filter predicate {op!r}")
+    return rows
