@@ -646,13 +646,20 @@ int ps_delete(void* handle, const uint8_t* id) {
 }
 
 int ps_abort(void* handle, const uint8_t* id) {
-  // Abort an unsealed create (e.g. writer failed mid-copy).
+  // Abort an unsealed create (e.g. writer failed mid-copy). Sealed
+  // objects are NOT abortable: readers may hold zero-copy views, so
+  // freeing here would be a cross-process use-after-free — sealed
+  // removal goes through ps_delete's pin-aware path instead.
   Store* s = static_cast<Store*>(handle);
   if (lock(s) != 0) return PS_ERROR;
   Entry* e = find_entry(s, id);
   if (!e) {
     unlock(s);
     return PS_NOT_FOUND;
+  }
+  if (e->state == kStateSealed) {
+    unlock(s);
+    return PS_NOT_SEALED;  // "wrong state for this op"
   }
   arena_free(s, e->offset);
   e->state = kStateTombstone;

@@ -64,21 +64,27 @@ def from_numpy(arr, column: str = "data",
 
 
 @ray_tpu.remote
-def _read_parquet_task(path: str, columns):
+def _read_parquet_task(path: str, columns, filters):
     import pyarrow.parquet as pq
 
-    table = pq.read_table(path, columns=columns)
+    # columns + filters push down into the parquet reader: row groups
+    # whose statistics exclude the predicate never leave disk
+    # (reference: datasource/parquet_datasource filter pushdown)
+    table = pq.read_table(path, columns=columns, filters=filters)
     return {
         name: table.column(name).to_numpy(zero_copy_only=False)
         for name in table.column_names
     }
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 filter: Optional[list] = None) -> Dataset:
     """One block per parquet file, read in parallel by tasks
-    (reference: data.read_parquet / datasource/parquet_datasource)."""
+    (reference: data.read_parquet / datasource/parquet_datasource).
+    `filter` takes pyarrow DNF filters, e.g. [("x", ">", 5)] — pushed
+    down to row-group pruning."""
     refs = [
-        _read_parquet_task.remote(f, columns)
+        _read_parquet_task.remote(f, columns, filter)
         for f in _expand_files(paths, ".parquet")
     ]
     return Dataset(refs)

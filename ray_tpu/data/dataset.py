@@ -246,6 +246,20 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    def to_arrow(self):
+        """Materialize as a list of pyarrow Tables, one per block
+        (reference: Dataset.to_arrow_refs — the Arrow bridge out)."""
+        import pyarrow as pa
+
+        out = []
+        for ref in self._iter_block_refs():
+            block = ray_tpu.get(ref)
+            if isinstance(block, dict):
+                out.append(pa.table({k: np.asarray(v) for k, v in block.items()}))
+            else:
+                out.append(pa.Table.from_pylist(list(block)))
+        return out
+
     # ---------------------------------------------------- simple aggregates
 
     def _column(self, column: str) -> np.ndarray:

@@ -78,6 +78,18 @@ def start(http_port: int = 0) -> int:
     return ray_tpu.get(controller.ensure_proxy.remote(http_port), timeout=120)
 
 
+def start_rpc_ingress(port: int = 0) -> int:
+    """Start the binary msgpack-RPC ingress beside the HTTP proxy
+    (the gRPC-ingress analogue, reference: serve/_private/proxy.py:540);
+    returns its port. Consume with serve.rpc_ingress.RpcIngressClient."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(
+        controller.ensure_rpc_ingress.remote(port), timeout=120
+    )
+
+
 def run(
     app: Application,
     *,

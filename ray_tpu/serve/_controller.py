@@ -191,6 +191,19 @@ class ServeController:
     async def get_proxy_port(self) -> int:
         return self._proxy_port
 
+    async def ensure_rpc_ingress(self, port: int = 0) -> int:
+        """Binary (msgpack-RPC) ingress beside the HTTP proxy (reference:
+        the gRPC proxy, serve/_private/proxy.py:540)."""
+        import ray_tpu
+
+        await self.ensure_proxy(0)
+        return await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: ray_tpu.get(
+                self._proxy.start_rpc_ingress.remote(port), timeout=60
+            ),
+        )
+
     async def ensure_proxy(self, port: int = 0) -> int:
         # Serialize concurrent callers: the second must await the first's
         # startup, not read a not-yet-assigned port 0.

@@ -46,6 +46,60 @@ class ProxyActor:
         logger.info("serve proxy listening on %d", self._port)
         return self._port
 
+    async def start_rpc_ingress(self, port: int = 0) -> int:
+        """Binary ingress on the framework's msgpack-RPC framing — the
+        counterpart of the reference's gRPC proxy (serve/_private/
+        proxy.py:540): non-HTTP clients call deployments with binary
+        payloads and typed errors, multiplexed over one connection.
+        Method: ServeCall {app, method?, args(pickled), kwargs(pickled)}
+        -> {result: pickled} | {error, app_error}."""
+        if getattr(self, "_rpc_server", None) is not None:
+            return self._rpc_port
+        from ray_tpu._private.rpc import RpcServer
+
+        srv = RpcServer("127.0.0.1")
+        srv.register("ServeCall", self._handle_rpc_call)
+        self._rpc_server = srv
+        self._rpc_port = await srv.start(port)
+        logger.info("serve rpc ingress on %d", self._rpc_port)
+        return self._rpc_port
+
+    async def _handle_rpc_call(self, req):
+        import cloudpickle
+
+        app = req.get("app")
+        info = None
+        if app is not None:
+            # refresh via _route's TTL machinery, then resolve by app name
+            await self._route("/")
+            info = self._routes.get(app)
+        if info is None:
+            return {"error": f"no such application {app!r}", "app_error": False}
+        ingress = info["ingress"]
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        method = req.get("method") or "__call__"
+        # cache per (ingress, method): a fresh handle per request would
+        # leak a long-poll thread each time and reset the p2c state
+        if not hasattr(self, "_rpc_handles"):
+            self._rpc_handles = {}
+        handle = self._rpc_handles.get((ingress, method))
+        if handle is None:
+            handle = DeploymentHandle(ingress, method_name=method)
+            self._rpc_handles[(ingress, method)] = handle
+        args = cloudpickle.loads(req["args"]) if req.get("args") else ()
+        kwargs = cloudpickle.loads(req["kwargs"]) if req.get("kwargs") else {}
+        loop = asyncio.get_running_loop()
+
+        def _call():
+            return handle.remote(*args, **kwargs).result(timeout=300)
+
+        try:
+            result = await loop.run_in_executor(self._pool, _call)
+        except Exception as e:  # noqa: BLE001 — typed back to the client
+            return {"error": str(e), "app_error": True}
+        return {"result": cloudpickle.dumps(result)}
+
     async def _route(self, path: str):
         """Longest route_prefix match. The route table refreshes on a short
         TTL and handles are cached per ingress, so the p2c router's

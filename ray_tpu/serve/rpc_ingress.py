@@ -1,0 +1,55 @@
+"""Client for the serve binary RPC ingress (the gRPC-ingress analogue,
+reference: serve/_private/proxy.py:540 gRPC proxy + generated stubs).
+
+    from ray_tpu import serve
+    from ray_tpu.serve.rpc_ingress import RpcIngressClient
+
+    port = serve.start_rpc_ingress()
+    client = RpcIngressClient("127.0.0.1", port)
+    out = client.call("default", arg1, method="predict", kw=2)
+    client.close()
+
+One persistent multiplexed connection; arbitrary python payloads ride
+cloudpickle both ways; application errors surface as RpcIngressError.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import cloudpickle
+
+from ray_tpu._private.rpc import IoThread, RpcClient
+
+
+class RpcIngressError(RuntimeError):
+    pass
+
+
+class RpcIngressClient:
+    def __init__(self, host: str, port: int):
+        self._io = IoThread.current()
+        self._client = RpcClient(host, port)
+        self._io.run(self._client.connect())
+
+    def call(self, app: str, *args, method: str = "__call__",
+             timeout: float = 300.0, **kwargs) -> Any:
+        req = {
+            "app": app,
+            "method": method,
+            "args": cloudpickle.dumps(args) if args else b"",
+            "kwargs": cloudpickle.dumps(kwargs) if kwargs else b"",
+        }
+        reply = self._io.run(
+            self._client.call("ServeCall", req, timeout=timeout),
+            timeout=timeout + 10,
+        )
+        if reply.get("error"):
+            raise RpcIngressError(reply["error"])
+        return cloudpickle.loads(reply["result"])
+
+    def close(self):
+        try:
+            self._io.run(self._client.close())
+        except Exception:
+            pass

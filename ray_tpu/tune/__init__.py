@@ -7,6 +7,12 @@ search-space primitives, ASHA + PBT schedulers, tune.report/get_checkpoint
 
 from ray_tpu.train._session import get_checkpoint, report  # noqa: F401
 from ray_tpu.tune.result_grid import ResultGrid, TrialResult  # noqa: F401
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    TPESearcher,
+)
 from ray_tpu.tune.schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,

@@ -93,8 +93,14 @@ class TuneController:
         resources_per_trial: Optional[Dict[str, float]] = None,
         max_concurrent: int = 0,
         restored_trials: Optional[List[Trial]] = None,
+        searcher=None,
+        num_samples: int = 0,
     ):
         self.trial_fn = trial_fn
+        # searcher mode: trials are suggested lazily as slots free, and
+        # completions feed back (reference: tune/search Searcher protocol)
+        self.searcher = searcher
+        self.num_samples = num_samples
         self.experiment_dir = experiment_dir
         self.scheduler = scheduler or FIFOScheduler()
         self.stop_criteria = stop or {}
@@ -195,16 +201,34 @@ class TuneController:
         os.makedirs(self.experiment_dir, exist_ok=True)
         pending = [t for t in self.trials if t.state == PENDING]
         done_states = (TERMINATED, ERRORED)
-        cap = self.max_concurrent or len(self.trials)
+        cap = (self.max_concurrent
+               or (self.num_samples if self.searcher else len(self.trials)))
 
         def maybe_launch():
             while pending and len(self.live_trials()) < cap:
                 self._start_trial(pending.pop(0))
+            if self.searcher is None:
+                return
+            while (len(self.trials) < self.num_samples
+                   and len(self.live_trials()) < cap):
+                tid = f"trial_{len(self.trials):05d}"
+                cfg = self.searcher.suggest(tid)
+                if cfg is None:
+                    break  # waiting on results (or exhausted)
+                trial = Trial(
+                    tid, cfg, os.path.join(self.experiment_dir, tid)
+                )
+                self.trials.append(trial)
+                self._start_trial(trial)
 
         maybe_launch()
         self._save_state()
         try:
-            while self._report_refs:
+            while True:
+                if not self._report_refs:
+                    maybe_launch()
+                    if not self._report_refs:
+                        break
                 ready, _ = ray_tpu.wait(
                     list(self._report_refs), num_returns=1, timeout=5.0
                 )
@@ -219,6 +243,12 @@ class TuneController:
                     except Exception as e:
                         trial.error = f"trial actor died: {e}"
                         self._stop_trial(trial, ERRORED)
+                        if self.searcher is not None:
+                            # the searcher must see EVERY terminal outcome
+                            # or ConcurrencyLimiter slots leak
+                            self.searcher.on_trial_complete(
+                                trial.id, error=True
+                            )
                         continue
                     self._handle_report(trial, report)
                 maybe_launch()
@@ -235,10 +265,14 @@ class TuneController:
         if kind == "finished":
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(self, trial, trial.last_result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.id, trial.last_result)
             return
         if kind == "error":
             trial.error = report.get("traceback") or report.get("error")
             self._stop_trial(trial, ERRORED)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.id, error=True)
             return
         # a live report round
         trial.iteration += 1
@@ -258,9 +292,13 @@ class TuneController:
                 # abort the experiment; let the trial continue.
                 logger.exception("scheduler failed on result for %s", trial.id)
                 decision = CONTINUE
+        if self.searcher is not None:
+            self.searcher.on_trial_result(trial.id, result)
         if decision == STOP:
             self._stop_trial(trial, TERMINATED)
             self.scheduler.on_trial_complete(self, trial, result)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.id, result)
             return
         if decision == EXPLOIT:
             self._exploit(trial)

@@ -34,6 +34,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0  # 0 = unbounded
     scheduler: Optional[Any] = None
+    # pluggable suggestion algorithm (reference: tune/search Searcher);
+    # None = grid/random expansion of param_space
+    search_alg: Optional[Any] = None
     seed: int = 0
 
 
@@ -156,6 +159,49 @@ class Tuner:
                 resources_per_trial=self._resources,
                 max_concurrent=tc.max_concurrent_trials,
                 restored_trials=self._restored_trials,
+            )
+        elif tc.search_alg is not None:
+            # lazy suggestion mode: the searcher hands out configs as trial
+            # slots free and consumes completions (sequential optimization)
+            space = {
+                k: v for k, v in self._param_space.items()
+                if k != "__trainer__"
+            }
+            tc.search_alg.set_search_properties(tc.metric, tc.mode, space)
+            # generators that expand a static variant list need the sample
+            # count (BasicVariantGenerator; custom ones may ignore it)
+            if hasattr(tc.search_alg, "num_samples"):
+                tc.search_alg.num_samples = tc.num_samples
+            inner = getattr(tc.search_alg, "searcher", None)
+            if inner is not None and hasattr(inner, "num_samples"):
+                inner.num_samples = tc.num_samples
+            if self._is_trainer:
+                base = tc.search_alg
+
+                class _TrainerWrap:
+                    def __getattr__(self, n):
+                        return getattr(base, n)
+
+                    def suggest(self, tid):
+                        cfg = base.suggest(tid)
+                        if cfg is not None:
+                            cfg = dict(
+                                cfg,
+                                __trainer__=self_outer._param_space["__trainer__"],
+                            )
+                        return cfg
+
+                self_outer = self
+                searcher = _TrainerWrap()
+            else:
+                searcher = tc.search_alg
+            controller = TuneController(
+                self._trial_fn, [], self.experiment_dir,
+                scheduler=tc.scheduler or FIFOScheduler(),
+                resources_per_trial=self._resources,
+                max_concurrent=tc.max_concurrent_trials,
+                searcher=searcher,
+                num_samples=tc.num_samples,
             )
         else:
             configs = generate_variants(

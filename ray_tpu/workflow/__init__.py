@@ -232,3 +232,12 @@ def list_all(status_filter: Optional[str] = None) -> List[Dict[str, Any]]:
 
 def delete(workflow_id: str):
     shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+# ------------------------------------------------------------ virtual actors
+from ray_tpu.workflow.virtual_actor import (  # noqa: E402,F401
+    VirtualActorHandle,
+    get_actor,
+    list_actors,
+    virtual_actor,
+)

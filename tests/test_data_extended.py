@@ -290,3 +290,24 @@ def test_sort_callable_tuple_key(data_cluster):
     assert [(r["a"], r["b"]) for r in out] == [
         (r["a"], r["b"]) for r in expect
     ]
+
+
+def test_parquet_filter_pushdown_and_arrow_bridge(data_cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    t = pa.table({"x": list(range(1000)), "y": [i * 2.0 for i in range(1000)]})
+    path = tmp_path / "t.parquet"
+    pq.write_table(t, path, row_group_size=100)
+
+    ds = rd.read_parquet(str(path), columns=["x"], filter=[("x", ">=", 900)])
+    rows = ds.take_all()
+    assert len(rows) == 100
+    assert all(r["x"] >= 900 for r in rows)
+    assert "y" not in rows[0]
+
+    # round trip through arrow
+    tables = rd.from_arrow(t).to_arrow()
+    merged = pa.concat_tables(tables)
+    assert merged.num_rows == 1000
+    assert merged.column("y").to_pylist()[:3] == [0.0, 2.0, 4.0]
