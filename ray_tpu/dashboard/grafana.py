@@ -1,0 +1,102 @@
+"""Grafana dashboard generation from the framework's metric catalog.
+
+Reference: dashboard/modules/metrics/grafana_dashboard_factory.py — the
+dashboard ships ready-made Grafana JSON for its Prometheus metrics. Same
+here: `generate_dashboard()` returns an importable Grafana dashboard
+covering the node/scheduler/object-store/worker gauges the GCS and
+raylets expose on their /metrics endpoints, and the dashboard head serves
+it at GET /api/grafana_dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+_PANELS = [
+    ("Cluster CPU utilization", [
+        ("sum(ray_tpu_node_resource_total{resource=\"CPU\"}) - "
+         "sum(ray_tpu_node_resource_available{resource=\"CPU\"})", "used"),
+        ("sum(ray_tpu_node_resource_total{resource=\"CPU\"})", "total"),
+    ], "short"),
+    ("TPU chips in use", [
+        ("sum(ray_tpu_node_resource_total{resource=\"TPU\"}) - "
+         "sum(ray_tpu_node_resource_available{resource=\"TPU\"})", "used"),
+        ("sum(ray_tpu_node_resource_total{resource=\"TPU\"})", "total"),
+    ], "short"),
+    ("Workers by state", [
+        ("sum(ray_tpu_node_workers) by (state)", "{{state}}"),
+    ], "short"),
+    ("Active leases", [
+        ("sum(ray_tpu_node_leases)", "leases"),
+    ], "short"),
+    ("Object store used", [
+        ("sum(ray_tpu_object_store_used_bytes)", "used"),
+        ("sum(ray_tpu_object_store_capacity_bytes)", "capacity"),
+    ], "bytes"),
+    ("Objects in store", [
+        ("sum(ray_tpu_object_store_num_objects)", "objects"),
+    ], "short"),
+    ("Spilled bytes", [
+        ("sum(ray_tpu_spilled_bytes)", "spilled"),
+    ], "bytes"),
+    ("Object pulls in flight", [
+        ("sum(ray_tpu_pulls_in_flight)", "pulls"),
+    ], "short"),
+    ("Node CPU percent", [
+        ("ray_tpu_node_cpu_percent", "{{node}}"),
+    ], "percent"),
+    ("Node memory used", [
+        ("ray_tpu_node_mem_used_bytes", "{{node}}"),
+    ], "bytes"),
+    ("Worker RSS", [
+        ("ray_tpu_worker_rss_bytes", "{{node}}/{{pid}}"),
+    ], "bytes"),
+    ("Placement-group bundles", [
+        ("sum(ray_tpu_node_pg_bundles)", "bundles"),
+    ], "short"),
+]
+
+
+def _panel(panel_id: int, title: str, targets: List[tuple], unit: str,
+           x: int, y: int) -> dict:
+    return {
+        "id": panel_id,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "targets": [
+            {"expr": expr, "legendFormat": legend, "refId": chr(65 + i)}
+            for i, (expr, legend) in enumerate(targets)
+        ],
+    }
+
+
+def generate_dashboard() -> dict:
+    """Importable Grafana dashboard JSON for the cluster's metrics."""
+    panels = []
+    for i, (title, targets, unit) in enumerate(_PANELS):
+        panels.append(
+            _panel(i + 1, title, targets, unit,
+                   x=(i % 2) * 12, y=(i // 2) * 8)
+        )
+    return {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-cluster",
+        "timezone": "browser",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {"list": [{
+            "name": "datasource",
+            "type": "datasource",
+            "query": "prometheus",
+        }]},
+        "panels": panels,
+    }
+
+
+def dashboard_json() -> str:
+    return json.dumps(generate_dashboard(), indent=2)

@@ -90,6 +90,10 @@ class DashboardHead:
             return self._logs_api(path, query or {})
         if path.startswith("/api/profile"):
             return self._profile_api(query or {})
+        if path == "/api/grafana_dashboard":
+            from ray_tpu.dashboard.grafana import generate_dashboard
+
+            return 200, generate_dashboard()
         if path.startswith("/api/jobs"):
             return self._jobs_api(path, method, body, query or {})
         if path == "/" or path == "/index.html":

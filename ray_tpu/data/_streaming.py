@@ -82,18 +82,36 @@ class MapOperator:
 
         import cloudpickle
 
+        from ray_tpu.data.backpressure import DataContext
+
         payload = cloudpickle.dumps(self.fn)
+        policies = DataContext.get_current().backpressure_policies
         # Yield in INPUT order (completion order would make block order — and
         # therefore take()/iter_batches contents — nondeterministic): block
-        # on the oldest outstanding task whenever the window is full.
+        # on the oldest outstanding task whenever the policy chain (default:
+        # the max_in_flight window; optionally object-store pressure) holds
+        # the next launch.
         in_flight: "collections.deque" = collections.deque()
         task = _map_block_task.options(num_cpus=self.num_cpus)
+
+        def may_launch():
+            return all(p.can_add_input(self, len(in_flight)) for p in policies)
+
         for ref in upstream:
+            while in_flight and not may_launch():
+                yield in_flight.popleft()
+            if not in_flight and not may_launch():
+                # resource-pressure hold with an empty window: give the
+                # consumer/spiller a bounded drain window, then proceed
+                # (progress beats a perfect cap)
+                import time as _time
+
+                deadline = _time.time() + 10
+                while not may_launch() and _time.time() < deadline:
+                    _time.sleep(0.05)
             in_flight.append(
                 task.remote(payload, ref, is_batch_fn=self.is_batch_fn)
             )
-            while len(in_flight) >= self.max_in_flight:
-                yield in_flight.popleft()
         while in_flight:
             yield in_flight.popleft()
 

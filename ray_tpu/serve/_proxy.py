@@ -53,16 +53,22 @@ class ProxyActor:
         payloads and typed errors, multiplexed over one connection.
         Method: ServeCall {app, method?, args(pickled), kwargs(pickled)}
         -> {result: pickled} | {error, app_error}."""
-        if getattr(self, "_rpc_server", None) is not None:
-            return self._rpc_port
-        from ray_tpu._private.rpc import RpcServer
+        # serialize concurrent starters (async actors interleave): the
+        # second caller must await the first's startup, not read an
+        # unassigned port
+        if getattr(self, "_rpc_lock", None) is None:
+            self._rpc_lock = asyncio.Lock()
+        async with self._rpc_lock:
+            if getattr(self, "_rpc_server", None) is not None:
+                return self._rpc_port
+            from ray_tpu._private.rpc import RpcServer
 
-        srv = RpcServer("127.0.0.1")
-        srv.register("ServeCall", self._handle_rpc_call)
-        self._rpc_server = srv
-        self._rpc_port = await srv.start(port)
-        logger.info("serve rpc ingress on %d", self._rpc_port)
-        return self._rpc_port
+            srv = RpcServer("127.0.0.1")
+            srv.register("ServeCall", self._handle_rpc_call)
+            self._rpc_port = await srv.start(port)
+            self._rpc_server = srv
+            logger.info("serve rpc ingress on %d", self._rpc_port)
+            return self._rpc_port
 
     async def _handle_rpc_call(self, req):
         import cloudpickle
@@ -89,10 +95,14 @@ class ProxyActor:
             self._rpc_handles[(ingress, method)] = handle
         args = cloudpickle.loads(req["args"]) if req.get("args") else ()
         kwargs = cloudpickle.loads(req["kwargs"]) if req.get("kwargs") else {}
+        # honor the client's deadline (capped): a hung replica must not
+        # pin a shared proxy-pool thread for 300s when the caller gave up
+        # after 10
+        timeout = min(float(req.get("timeout") or 300.0), 300.0)
         loop = asyncio.get_running_loop()
 
         def _call():
-            return handle.remote(*args, **kwargs).result(timeout=300)
+            return handle.remote(*args, **kwargs).result(timeout=timeout)
 
         try:
             result = await loop.run_in_executor(self._pool, _call)

@@ -37,6 +37,7 @@ class RpcIngressClient:
         req = {
             "app": app,
             "method": method,
+            "timeout": timeout,
             "args": cloudpickle.dumps(args) if args else b"",
             "kwargs": cloudpickle.dumps(kwargs) if kwargs else b"",
         }

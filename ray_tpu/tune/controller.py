@@ -209,7 +209,13 @@ class TuneController:
                 self._start_trial(pending.pop(0))
             if self.searcher is None:
                 return
-            while (len(self.trials) < self.num_samples
+            # generators expanding grids can produce more than num_samples
+            # variants (num_samples per grid point); honor their total
+            limit = max(
+                self.num_samples,
+                getattr(self.searcher, "total_variants", 0) or 0,
+            )
+            while (len(self.trials) < limit
                    and len(self.live_trials()) < cap):
                 tid = f"trial_{len(self.trials):05d}"
                 cfg = self.searcher.suggest(tid)

@@ -61,13 +61,15 @@ class BasicVariantGenerator(Searcher):
     """Grid/random expansion, served lazily (reference:
     tune/search/basic_variant.py)."""
 
-    def __init__(self, max_concurrent: int = 0, seed: int = 0):
+    def __init__(self, seed: int = 0):
         super().__init__()
         self._seed = seed
         self._variants: Optional[List[dict]] = None
         self._i = 0
-        self.max_concurrent = max_concurrent
         self.num_samples = 1
+        # grid expansion can exceed num_samples (num_samples x |grid|);
+        # the controller raises its trial cap to this once known
+        self.total_variants = 0
 
     def set_search_properties(self, metric, mode, param_space):
         super().set_search_properties(metric, mode, param_space)
@@ -78,6 +80,7 @@ class BasicVariantGenerator(Searcher):
             self._variants = generate_variants(
                 self.param_space, self.num_samples, seed=self._seed
             )
+            self.total_variants = len(self._variants)
         if self._i >= len(self._variants):
             return None
         cfg = self._variants[self._i]

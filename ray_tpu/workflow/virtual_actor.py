@@ -67,12 +67,32 @@ def _latest_seq(adir: str) -> int:
     return best
 
 
-def _commit_state(adir: str, seq: int, state: dict):
+def _commit_state(adir: str, seq: int, state: dict, exclusive: bool = False):
+    """Write snapshot `seq`. exclusive=True is optimistic concurrency for
+    method commits: os.link fails if ANOTHER writer committed this seq
+    first, turning a cross-handle race into a loud conflict instead of a
+    silent lost update."""
     path = os.path.join(adir, f"state_{seq:08d}.pkl")
-    tmp = path + ".tmp"
+    tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         cloudpickle.dump(state, f)
-    os.replace(tmp, path)
+    try:
+        if exclusive:
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                raise RuntimeError(
+                    f"concurrent write conflict on virtual actor state "
+                    f"{path} — another handle committed seq {seq} first; "
+                    "retry the call against the new state"
+                )
+        else:
+            os.replace(tmp, path)
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
     # retain only the latest two snapshots (the previous one guards
     # against a torn read racing the replace on exotic filesystems)
     for f in os.listdir(adir):
@@ -88,7 +108,7 @@ def _commit_state(adir: str, seq: int, state: dict):
                     pass
 
 
-@ray_tpu.remote
+@ray_tpu.remote(max_retries=0)
 def _virtual_actor_call(adir: str, method_name: str, args, kwargs,
                         readonly: bool):
     """One durable method call: load latest state -> apply -> commit."""
@@ -103,7 +123,7 @@ def _virtual_actor_call(adir: str, method_name: str, args, kwargs,
     inst.__dict__.update(state)
     result = getattr(inst, method_name)(*args, **kwargs)
     if not readonly:
-        _commit_state(adir, seq + 1, dict(inst.__dict__))
+        _commit_state(adir, seq + 1, dict(inst.__dict__), exclusive=True)
     return result
 
 

@@ -311,3 +311,42 @@ def test_parquet_filter_pushdown_and_arrow_bridge(data_cluster, tmp_path):
     merged = pa.concat_tables(tables)
     assert merged.num_rows == 1000
     assert merged.column("y").to_pylist()[:3] == [0.0, 2.0, 4.0]
+
+
+def test_backpressure_policy_plugin(data_cluster):
+    """A custom policy throttles per-operator concurrency (reference:
+    backpressure_policy/ plugin chain)."""
+    from ray_tpu.data.backpressure import (
+        BackpressurePolicy,
+        ConcurrencyCapBackpressurePolicy,
+        DataContext,
+    )
+
+    ctx = DataContext.get_current()
+    saved = list(ctx.backpressure_policies)
+
+    class CapOne(BackpressurePolicy):
+        def __init__(self):
+            self.max_seen = 0
+
+        def can_add_input(self, op, in_flight):
+            self.max_seen = max(self.max_seen, in_flight)
+            return in_flight < 1
+
+    probe = CapOne()
+    try:
+        ctx.backpressure_policies = [probe]
+        ds = rd.range(40, override_num_blocks=8)
+        out = ds.map_batches(
+            lambda b: {"id": b["id"] * 2}, max_in_flight=8
+        ).take_all()
+        assert len(out) == 40
+        assert probe.max_seen <= 1  # never more than 1 in flight
+    finally:
+        ctx.backpressure_policies = saved
+
+    # default chain caps at the operator's window
+    assert isinstance(
+        DataContext.get_current().backpressure_policies[0],
+        ConcurrencyCapBackpressurePolicy,
+    )

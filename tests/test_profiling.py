@@ -83,3 +83,23 @@ def test_node_stats_in_metrics(cluster):
         text = resp.read().decode()
     assert "ray_tpu_node_cpu_percent" in text
     assert "ray_tpu_node_mem_total_bytes" in text
+
+
+def test_grafana_dashboard_generation():
+    """No cluster needed: the generated dashboard is valid Grafana JSON
+    covering the exported metric families (reference:
+    grafana_dashboard_factory.py)."""
+    import json as _json
+
+    from ray_tpu.dashboard.grafana import dashboard_json, generate_dashboard
+
+    d = generate_dashboard()
+    assert d["uid"] == "ray-tpu-cluster"
+    assert len(d["panels"]) >= 10
+    exprs = " ".join(
+        t["expr"] for p in d["panels"] for t in p["targets"]
+    )
+    for fam in ("ray_tpu_node_resource_total", "ray_tpu_object_store_used",
+                "ray_tpu_node_cpu_percent", "ray_tpu_worker_rss_bytes"):
+        assert fam in exprs
+    _json.loads(dashboard_json())  # serializes cleanly
