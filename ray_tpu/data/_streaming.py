@@ -141,11 +141,27 @@ class MapOperator:
         ]
         import collections
 
+        from ray_tpu.data.backpressure import DataContext
+
+        policies = DataContext.get_current().backpressure_policies
         per_actor_cap = max(2, self.max_in_flight // len(pool))
         in_flight: "collections.deque" = collections.deque()  # (ref, idx)
         load = [0] * len(pool)
+
+        def may_launch():
+            # the actor path honors the same policy chain as the task
+            # path (memory pressure etc.); the pool window is an
+            # additional per-actor cap
+            return all(
+                p.can_add_input(self, sum(load)) for p in policies
+            )
+
         try:
             for ref in upstream:
+                while in_flight and not may_launch():
+                    done_ref, done_idx = in_flight.popleft()
+                    load[done_idx] -= 1
+                    yield done_ref
                 while sum(load) >= per_actor_cap * len(pool):
                     done_ref, done_idx = in_flight.popleft()
                     load[done_idx] -= 1

@@ -201,22 +201,31 @@ class TuneController:
         os.makedirs(self.experiment_dir, exist_ok=True)
         pending = [t for t in self.trials if t.state == PENDING]
         done_states = (TERMINATED, ERRORED)
-        cap = (self.max_concurrent
-               or (self.num_samples if self.searcher else len(self.trials)))
 
-        def maybe_launch():
-            while pending and len(self.live_trials()) < cap:
-                self._start_trial(pending.pop(0))
-            if self.searcher is None:
-                return
+        def trial_limit():
             # generators expanding grids can produce more than num_samples
             # variants (num_samples per grid point); honor their total
-            limit = max(
+            return max(
                 self.num_samples,
                 getattr(self.searcher, "total_variants", 0) or 0,
             )
-            while (len(self.trials) < limit
-                   and len(self.live_trials()) < cap):
+
+        def current_cap():
+            if self.max_concurrent:
+                return self.max_concurrent
+            # match the non-searcher path's parallelism: a grid sweep must
+            # not serialize just because it came through a searcher
+            return trial_limit() if self.searcher else len(self.trials)
+
+        def maybe_launch():
+            while pending and len(self.live_trials()) < current_cap():
+                self._start_trial(pending.pop(0))
+            if self.searcher is None:
+                return
+            # caps recompute per iteration: grid totals are only known
+            # after the generator's first suggest() expands the space
+            while (len(self.trials) < trial_limit()
+                   and len(self.live_trials()) < current_cap()):
                 tid = f"trial_{len(self.trials):05d}"
                 cfg = self.searcher.suggest(tid)
                 if cfg is None:

@@ -232,6 +232,21 @@ class ConcurrencyLimiter(Searcher):
         self.max_concurrent = max_concurrent
         self._live: set = set()
 
+    @property
+    def total_variants(self) -> int:
+        # grid-expansion totals must see through the wrapper or the
+        # controller under-counts the trial cap
+        return getattr(self.searcher, "total_variants", 0)
+
+    @property
+    def num_samples(self) -> int:
+        return getattr(self.searcher, "num_samples", 1)
+
+    @num_samples.setter
+    def num_samples(self, v: int):
+        if hasattr(self.searcher, "num_samples"):
+            self.searcher.num_samples = v
+
     def set_search_properties(self, metric, mode, param_space):
         super().set_search_properties(metric, mode, param_space)
         self.searcher.set_search_properties(metric, mode, param_space)

@@ -139,3 +139,64 @@ def span(name: str, attributes: Optional[dict] = None):
             w.task_events.record_span(
                 name, start, end, ctx, attributes or {}, error
             )
+        _export_span(name, start, end, ctx, attributes or {}, error)
+
+
+# ------------------------------------------------------------ span export
+# Pluggable exporter seam (reference: util/tracing/tracing_helper.py wires
+# OpenTelemetry when installed). The runtime-native sink (task events →
+# timeline) always records; an exporter additionally receives each
+# finished span as a dict — set_span_exporter(fn) for custom sinks, or
+# enable_otel_export() to bridge into an installed opentelemetry SDK.
+
+_exporter = None
+
+
+def set_span_exporter(fn) -> None:
+    """fn({name, start, end, trace_id, span_id, parent_span_id,
+    attributes, error}) called per finished span in-process."""
+    global _exporter
+    _exporter = fn
+
+
+def _export_span(name, start, end, ctx, attributes, error):
+    if _exporter is None:
+        return
+    try:
+        _exporter({
+            "name": name, "start": start, "end": end,
+            "trace_id": ctx.get("trace_id"),
+            "span_id": ctx.get("span_id"),
+            "parent_span_id": ctx.get("parent_span_id"),
+            "attributes": attributes, "error": error,
+        })
+    except Exception:
+        pass  # an exporter bug must never fail user code
+
+
+def enable_otel_export(tracer_name: str = "ray_tpu") -> bool:
+    """Bridge spans into an installed OpenTelemetry SDK (no-op False when
+    opentelemetry is absent — the framework carries no hard dependency)."""
+    try:
+        from opentelemetry import trace as otel_trace
+    except ImportError:
+        return False
+    tracer = otel_trace.get_tracer(tracer_name)
+
+    def export(span_dict):
+        otel_span = tracer.start_span(
+            span_dict["name"],
+            start_time=int(span_dict["start"] * 1e9),
+            attributes={
+                **{str(k): str(v)
+                   for k, v in span_dict["attributes"].items()},
+                "rtpu.trace_id": span_dict["trace_id"] or "",
+                "rtpu.parent_span_id": span_dict["parent_span_id"] or "",
+            },
+        )
+        if span_dict["error"]:
+            otel_span.set_attribute("error", span_dict["error"])
+        otel_span.end(end_time=int(span_dict["end"] * 1e9))
+
+    set_span_exporter(export)
+    return True

@@ -91,3 +91,31 @@ def test_disabled_is_no_op(ray_start_regular):
         return t.current_context()
 
     assert ray_tpu.get(f.remote()) is None
+
+
+def test_span_exporter_seam(ray_start_regular):
+    """Pluggable exporter receives finished spans (reference:
+    tracing_helper.py OTel wiring; enable_otel_export no-ops without the
+    SDK installed)."""
+    from ray_tpu.util import tracing
+
+    got = []
+    tracing.set_span_exporter(got.append)
+    try:
+        tracing.enable()
+        with tracing.span("outer", {"k": "v"}):
+            with tracing.span("inner"):
+                pass
+        names = [s["name"] for s in got]
+        assert names == ["inner", "outer"]
+        inner, outer = got
+        assert inner["parent_span_id"] == outer["span_id"]
+        assert inner["trace_id"] == outer["trace_id"]
+        assert outer["attributes"] == {"k": "v"}
+        # exporter exceptions never propagate to user code
+        tracing.set_span_exporter(lambda s: 1 / 0)
+        with tracing.span("safe"):
+            pass
+    finally:
+        tracing.set_span_exporter(None)
+        tracing.disable()
