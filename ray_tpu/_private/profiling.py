@@ -69,3 +69,72 @@ def folded_text(counts: Dict[str, int]) -> str:
         f"{stack} {n}"
         for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
     )
+
+
+def profile_via_raylets(nodes, *, pid=None, worker_id=None,
+                        node_filter=None, duration=2.0, hz=100.0):
+    """Shared fan-out used by the dashboard endpoint AND the CLI: resolve
+    the target worker across alive raylets and run a ProfileWorker RPC.
+
+    Returns (status, payload) with HTTP-shaped statuses: 200 + result,
+    400 on cross-node pid ambiguity (pids are only unique per host),
+    404 when no node has the worker, 502 when raylets were unreachable.
+    """
+    from ray_tpu._private.rpc import IoThread, RpcClient
+
+    io = IoThread.current()
+    req = {"duration": duration, "hz": hz}
+    if pid is not None:
+        req["pid"] = int(pid)
+    if worker_id is not None:
+        req["worker_id"] = worker_id
+    nodes = [
+        n for n in nodes
+        if n.get("state", "ALIVE") == "ALIVE"
+        and (not node_filter or n["node_id"].hex().startswith(node_filter))
+    ]
+
+    async def ask(n, method, payload, timeout):
+        client = RpcClient(n["ip"], n["raylet_port"])
+        await client.connect()
+        try:
+            return await client.call(method, payload, timeout=timeout)
+        finally:
+            await client.close()
+
+    if pid is not None and not node_filter and len(nodes) > 1:
+        holders = []
+        for n in nodes:
+            try:
+                info = io.run(
+                    ask(n, "GetLocalWorkerInfo", {}, 15), timeout=20
+                )
+            except Exception:
+                continue
+            if any(w["pid"] == req["pid"] for w in info.get("workers", [])):
+                holders.append(n)
+        if len(holders) > 1:
+            return 400, {
+                "error": f"pid {pid} exists on {len(holders)} nodes; "
+                "disambiguate with node_id",
+            }
+        if holders:
+            nodes = holders
+
+    transport_err = None
+    worker_err = None
+    for n in nodes:
+        try:
+            r = io.run(
+                ask(n, "ProfileWorker", req, duration + 40),
+                timeout=duration + 60,
+            )
+        except Exception as e:
+            transport_err = str(e)
+            continue
+        if not r.get("error"):
+            return 200, r
+        worker_err = r["error"]
+    if transport_err:
+        return 502, {"error": f"some raylets unreachable: {transport_err}"}
+    return 404, {"error": worker_err or "no such worker on any alive node"}

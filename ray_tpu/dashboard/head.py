@@ -141,70 +141,17 @@ class DashboardHead:
         try:
             duration = float(query.get("duration", 2.0) or 2.0)
             hz = float(query.get("hz", 100.0) or 100.0)
-            req = {"duration": duration, "hz": hz}
-            if pid:
-                req["pid"] = int(pid)
-            if worker_id:
-                req["worker_id"] = bytes.fromhex(worker_id)
+            pid = int(pid) if pid else None
+            wid = bytes.fromhex(worker_id) if worker_id else None
         except ValueError as e:
             return 400, {"error": f"bad query value: {e}"}
-        node_filter = query.get("node_id")
-        gcs = self._gcs_client()
-        nodes = [
-            n for n in gcs.get_all_node_info()
-            if n.get("state", "ALIVE") == "ALIVE"
-            and (not node_filter or n["node_id"].hex().startswith(node_filter))
-        ]
-        from ray_tpu._private.rpc import IoThread, RpcClient
+        from ray_tpu._private.profiling import profile_via_raylets
 
-        io = IoThread.current()
-        async def ask_node(n, method, payload, timeout):
-            client = RpcClient(n["ip"], n["raylet_port"])
-            await client.connect()
-            try:
-                return await client.call(method, payload, timeout=timeout)
-            finally:
-                await client.close()
-
-        if pid and not node_filter and len(nodes) > 1:
-            # pids are only unique per host: find which nodes have this
-            # pid FIRST, and refuse ambiguity rather than profiling an
-            # unrelated process on whichever node answers first
-            holders = []
-            for n in nodes:
-                try:
-                    info = io.run(
-                        ask_node(n, "GetLocalWorkerInfo", {}, 15), timeout=20
-                    )
-                except Exception:
-                    continue
-                if any(w["pid"] == req["pid"] for w in info.get("workers", [])):
-                    holders.append(n)
-            if len(holders) > 1:
-                return 400, {
-                    "error": f"pid {pid} exists on "
-                    f"{len(holders)} nodes; disambiguate with &node_id=",
-                }
-            if holders:
-                nodes = holders
-
-        last_err = None
-        for n in nodes:
-            async def ask(n=n):
-                return await ask_node(n, "ProfileWorker", req, duration + 40)
-
-            try:
-                r = io.run(ask(), timeout=duration + 60)
-            except Exception as e:
-                # an unreachable raylet must not mask workers on the
-                # remaining nodes
-                last_err = str(e)
-                continue
-            if not r.get("error"):
-                return 200, r
-        if last_err:
-            return 502, {"error": f"some raylets unreachable: {last_err}"}
-        return 404, {"error": "no such worker on any alive node"}
+        return profile_via_raylets(
+            self._gcs_client().get_all_node_info(),
+            pid=pid, worker_id=wid, node_filter=query.get("node_id"),
+            duration=duration, hz=hz,
+        )
 
     def _session_dir(self) -> str:
         """Cluster session dir from the GCS, cached (it never changes);

@@ -13,6 +13,9 @@ Commands:
   actors   [--address]
   memory   [--address]           object-store usage per node
   timeline [--address] -o FILE   Chrome-trace dump
+  profile  [--address] --pid N [--duration S] [-o FILE]
+                                 flamegraph-folded stack sample of a worker
+  grafana  [-o FILE]             generated Grafana dashboard JSON
   job submit  --address ADDR -- ENTRYPOINT...
   job status  --address ADDR SUBMISSION_ID
   job logs    --address ADDR SUBMISSION_ID
@@ -165,6 +168,43 @@ def cmd_memory(args):
         print("no objects")
 
 
+def cmd_profile(args):
+    """On-demand stack sampling of a worker by pid (reference: `ray`'s
+    dashboard py-spy integration). Shares the dashboard endpoint's
+    fan-out — same cross-node pid-ambiguity guard and error semantics."""
+    from ray_tpu._private.gcs.client import GcsClient
+    from ray_tpu._private.profiling import profile_via_raylets
+
+    gcs = GcsClient.from_address(_resolve_address(args))
+    status, payload = profile_via_raylets(
+        gcs.get_all_node_info(), pid=args.pid,
+        node_filter=args.node_id, duration=args.duration, hz=args.hz,
+    )
+    if status != 200:
+        print(f"error ({status}): {payload.get('error')}", file=sys.stderr)
+        sys.exit(1)
+    out = payload["folded"]
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out + "\n")
+        print(f"wrote {payload['samples']} samples to {args.output}")
+    else:
+        print(out)
+
+
+def cmd_grafana(args):
+    """Dump the generated Grafana dashboard JSON (reference:
+    grafana_dashboard_factory.py)."""
+    from ray_tpu.dashboard.grafana import dashboard_json
+
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dashboard_json())
+        print(f"wrote dashboard to {args.output}")
+    else:
+        print(dashboard_json())
+
+
 def cmd_timeline(args):
     from ray_tpu._private.gcs.client import GcsClient
     from ray_tpu._private.timeline import chrome_trace_events
@@ -230,6 +270,19 @@ def main(argv=None):
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("profile")
+    p.add_argument("--address", default=None)
+    p.add_argument("--pid", type=int, required=True)
+    p.add_argument("--node-id", dest="node_id", default=None)
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--hz", type=float, default=100.0)
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("grafana")
+    p.add_argument("-o", "--output", default=None)
+    p.set_defaults(fn=cmd_grafana)
 
     p = sub.add_parser("job")
     p.add_argument("--address", default=None)

@@ -103,3 +103,41 @@ def test_grafana_dashboard_generation():
                 "ray_tpu_node_cpu_percent", "ray_tpu_worker_rss_bytes"):
         assert fam in exprs
     _json.loads(dashboard_json())  # serializes cleanly
+
+
+def test_cli_profile_and_grafana(cluster, tmp_path):
+    """Operator CLI: `ray-tpu profile --pid` and `ray-tpu grafana`
+    (dogfooding the endpoints from the command line)."""
+    from ray_tpu import api, scripts
+
+    @ray_tpu.remote
+    class Busy:
+        def work(self, s):
+            t0 = time.time()
+            x = 0
+            while time.time() - t0 < s:
+                x += sum(i for i in range(50))
+            return x
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    b = Busy.remote()
+    pid = ray_tpu.get(b.pid.remote())
+    ref = b.work.remote(5.0)
+    time.sleep(0.3)
+    out_file = tmp_path / "prof.folded"
+    scripts.main([
+        "profile", "--address", api._local_node.gcs_address,
+        "--pid", str(pid), "--duration", "1.5", "-o", str(out_file),
+    ])
+    folded = out_file.read_text()
+    assert "work" in folded and folded.splitlines()
+    ray_tpu.get(ref)
+
+    g_file = tmp_path / "dash.json"
+    scripts.main(["grafana", "-o", str(g_file)])
+    dash = json.loads(g_file.read_text())
+    assert dash["uid"] == "ray-tpu-cluster"
