@@ -106,8 +106,11 @@ def profile_via_raylets(nodes, *, pid=None, worker_id=None,
         holders = []
         for n in nodes:
             try:
+                # short probe timeout: this runs sequentially in a sync
+                # HTTP/CLI path, and an unreachable raylet must not add
+                # tens of seconds before profiling starts
                 info = io.run(
-                    ask(n, "GetLocalWorkerInfo", {}, 15), timeout=20
+                    ask(n, "GetLocalWorkerInfo", {}, 4), timeout=6
                 )
             except Exception:
                 continue

@@ -83,6 +83,87 @@ class FakeMultiNodeProvider(NodeProvider):
             rec["node"].shutdown()
 
 
+class CommandNodeProvider(NodeProvider):
+    """Generic on-prem/provisioner provider: nodes launch and terminate
+    via user-configured shell commands (reference: the local/on-prem
+    provider and ssh updater stack, autoscaler/_private/local/ +
+    command_runner.py — the cloud-SDK providers are that machinery with
+    vendor APIs swapped in).
+
+    Per node type:
+        {"up": "ssh host1 ray-tpu start --address $gcs_address",
+         "down": "ssh host1 pkill -f raylet"}   # optional
+
+    Placeholders use $-substitution ($gcs_address, $node_type,
+    $provider_node_id) so shell/JSON braces in commands never need
+    escaping. The "up" command must start a node that joins the cluster
+    (e.g. the `ray-tpu start --address` CLI); "down" tears it down.
+    Commands run synchronously; the autoscaler's view of cluster
+    membership comes from GCS node records as usual.
+    """
+
+    def __init__(self, gcs_address: str, node_types: Dict[str, dict],
+                 command_timeout_s: float = 120.0):
+        self.gcs_address = gcs_address
+        self.node_types = node_types
+        self.command_timeout_s = command_timeout_s
+        self._nodes: Dict[str, str] = {}  # provider id -> node type
+        self._lock = threading.Lock()
+
+    def _run(self, template: str, node_type: str, pid: str):
+        import string
+        import subprocess
+
+        cmd = string.Template(template).safe_substitute(
+            gcs_address=self.gcs_address, node_type=node_type,
+            provider_node_id=pid,
+        )
+        try:
+            r = subprocess.run(
+                cmd, shell=True, capture_output=True, text=True,
+                timeout=self.command_timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"provider command failed ({cmd!r}): timed out after "
+                f"{self.command_timeout_s}s — NOTE: only the shell was "
+                "killed; a grandchild provisioner may still be running "
+                "and its node could join the cluster unrecorded"
+            )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"provider command failed ({cmd!r}):\n{r.stdout[-1000:]}"
+                f"\n{r.stderr[-1000:]}"
+            )
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        cfg = self.node_types[node_type]
+        created = []
+        for _ in range(count):
+            pid = f"cmd-{node_type}-{uuid.uuid4().hex[:6]}"
+            self._run(cfg["up"], node_type, pid)
+            with self._lock:
+                self._nodes[pid] = node_type
+            created.append(pid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            node_type = self._nodes.pop(provider_node_id, None)
+        if node_type is None:
+            return
+        down = self.node_types.get(node_type, {}).get("down")
+        if down:
+            try:
+                self._run(down, node_type, provider_node_id)
+            except Exception:
+                pass  # best effort — GCS health marks the node dead anyway
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes)
+
+
 class RecordingNodeProvider(NodeProvider):
     """Test double that only records launch/terminate calls."""
 
