@@ -41,6 +41,12 @@ _FLAGS: Dict[str, Any] = {
     "task_push_max_batch": 16,
     # Cap on concurrent RequestWorkerLease RPCs per scheduling key.
     "max_lease_requests_in_flight": 16,
+    # How many actor-creation lease BATCHES the GCS drives concurrently;
+    # each batch pays one GCS->raylet round-trip for up to
+    # actor_creation_lease_batch actors (reference: gcs_actor_scheduler.cc
+    # leases per-actor in parallel; we batch on top).
+    "actor_creation_parallelism": 8,
+    "actor_creation_lease_batch": 16,
     # Actor-task pushes pipeline up to this many batch RPCs per actor
     # (reference: actor_task_submitter.h pushes without waiting for prior
     # replies; the receiver's seq_no reorder buffer restores order).

@@ -56,6 +56,17 @@ class FunctionManager:
             pass
         return key
 
+    def seed(self, key: bytes, data: bytes):
+        """Pre-populate the cache from a blob fetched by someone else (the
+        raylet ships the actor class in the spawn message so freshly-forked
+        actor workers skip the per-process KV round-trip)."""
+        with self._lock:
+            if key in self._cache:
+                return
+        obj = cloudpickle.loads(data)
+        with self._lock:
+            self._cache[key] = obj
+
     def fetch_cached(self, key: bytes) -> Any:
         """Non-blocking cache probe; None on miss (callers then fetch() off
         the io loop — the KV round-trip blocks)."""

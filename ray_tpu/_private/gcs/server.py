@@ -80,32 +80,59 @@ class PubSub:
 
     def __init__(self):
         self._subs: Dict[bytes, Dict[str, Any]] = {}
+        # Exact-channel and wildcard-prefix indexes: publish() must not scan
+        # every subscriber's channel set (a driver watching N actors holds N
+        # channels — the scan made actor-burst publishing O(N^2)).
+        self._exact: Dict[str, set] = {}
+        self._prefix: Dict[str, set] = {}
 
     def subscribe(self, sub_id: bytes, channel: str):
         sub = self._subs.setdefault(
             sub_id, {"channels": set(), "queue": [], "event": asyncio.Event()}
         )
         sub["channels"].add(channel)
+        if channel.endswith("*"):
+            self._prefix.setdefault(channel[:-1], set()).add(sub_id)
+        else:
+            self._exact.setdefault(channel, set()).add(sub_id)
+
+    def _unindex(self, sub_id: bytes, channel: str):
+        table, key = (
+            (self._prefix, channel[:-1]) if channel.endswith("*")
+            else (self._exact, channel)
+        )
+        ids = table.get(key)
+        if ids is not None:
+            ids.discard(sub_id)
+            if not ids:
+                del table[key]
 
     def unsubscribe(self, sub_id: bytes, channel: Optional[str]):
         sub = self._subs.get(sub_id)
         if not sub:
             return
         if channel is None:
+            for ch in sub["channels"]:
+                self._unindex(sub_id, ch)
             del self._subs[sub_id]
         else:
             sub["channels"].discard(channel)
+            self._unindex(sub_id, channel)
 
     def publish(self, channel: str, message):
-        for sub in self._subs.values():
-            for ch in sub["channels"]:
-                if channel == ch or (ch.endswith("*") and channel.startswith(ch[:-1])):
-                    q = sub["queue"]
-                    q.append([channel, message])
-                    if len(q) > RTPU_CONFIG.pubsub_max_batch:
-                        del q[: len(q) - RTPU_CONFIG.pubsub_max_batch]
-                    sub["event"].set()
-                    break
+        targets = set(self._exact.get(channel, ()))
+        for prefix, ids in self._prefix.items():
+            if channel.startswith(prefix):
+                targets |= ids
+        for sub_id in targets:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                continue
+            q = sub["queue"]
+            q.append([channel, message])
+            if len(q) > RTPU_CONFIG.pubsub_max_batch:
+                del q[: len(q) - RTPU_CONFIG.pubsub_max_batch]
+            sub["event"].set()
 
     async def poll(self, sub_id: bytes, timeout: float):
         sub = self._subs.setdefault(
@@ -154,6 +181,17 @@ class GcsServer:
         self.actors: Dict[bytes, dict] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}  # (ns, name) -> actor_id
         self.pending_actor_queue: List[bytes] = []
+        # Concurrent actor creation: the pump leases workers for many pending
+        # actors at once (reference: gcs_actor_scheduler.cc leases in parallel
+        # per actor); the semaphore bounds in-flight creations and
+        # _actor_inflight stops concurrent picks from over-committing a node
+        # before its next resource report lands.
+        self._actor_create_sem = asyncio.Semaphore(
+            RTPU_CONFIG.actor_creation_parallelism
+        )
+        self._actor_inflight: Dict[bytes, Dict[str, float]] = {}
+        # kill() seen before the (async-batched) registration arrived
+        self._kill_tombstones: set = set()
         # pg_id(bytes) -> record
         self.placement_groups: Dict[bytes, dict] = {}
         self.pending_pg_queue: List[bytes] = []
@@ -544,6 +582,14 @@ class GcsServer:
         # poll would otherwise go unnoticed forever).
         return {"ok": True, "epoch": self.epoch}
 
+    async def handle_SubscribeMany(self, req):
+        """Batch subscribe: one round-trip for a burst of channels (the
+        driver's batched actor registration subscribes N watch channels at
+        once)."""
+        for ch in req["channels"]:
+            self.pubsub.subscribe(req["sub_id"], ch)
+        return {"ok": True, "epoch": self.epoch}
+
     async def handle_Unsubscribe(self, req):
         self.pubsub.unsubscribe(req["sub_id"], req.get("channel"))
         return {"ok": True}
@@ -601,13 +647,47 @@ class GcsServer:
 
     # ------------------------------------------------------------------ actors
 
+    async def handle_RegisterActors(self, req):
+        """Batched registration of anonymous actors: one RPC, one pump kick
+        (the driver coalesces a `.remote()` burst into this)."""
+        for item in req["items"]:
+            self._register_actor_record(item)
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return {"ok": True}
+
     async def handle_RegisterActor(self, req):
         """Register + asynchronously schedule an actor creation.
 
         req: {actor_id, creation_spec(task spec dict), name, ray_namespace,
               max_restarts, detached}
         """
+        self._register_actor_record(req)
+        asyncio.ensure_future(self._schedule_pending_actors())
+        return {"ok": True}
+
+    def _register_actor_record(self, req):
         actor_id = req["actor_id"]
+        if actor_id in self.actors:
+            # Idempotent: a client retry of its own registration (after a
+            # dropped reply / GCS failover) must not reset a live actor back
+            # to PENDING_CREATION and re-schedule it.
+            return
+        if actor_id in self._kill_tombstones:
+            self._kill_tombstones.discard(actor_id)
+            rec = {
+                "actor_id": actor_id, "state": DEAD,
+                "creation_spec": req["creation_spec"], "name": req.get("name") or "",
+                "namespace": req.get("namespace") or "",
+                "max_restarts": 0, "num_restarts": 0,
+                "detached": req.get("detached", False),
+                "owner_worker_id": req["creation_spec"].get("owner_worker_id"),
+                "node_id": None, "worker_id": None, "addr": None,
+                "job_id": req["creation_spec"]["job_id"],
+                "death_cause": "killed via kill()", "start_time": time.time(),
+            }
+            self.actors[actor_id] = rec
+            self._publish_actor(actor_id, rec)
+            return
         name = req.get("name") or ""
         ns = req.get("namespace") or ""
         if name:
@@ -639,8 +719,6 @@ class GcsServer:
         }
         self._persist_actor(self.actors[actor_id])
         self.pending_actor_queue.append(actor_id)
-        asyncio.ensure_future(self._schedule_pending_actors())
-        return {"ok": True}
 
     def _pick_node(self, resources: Dict[str, float], strategy: dict) -> Optional[bytes]:
         """Hybrid placement for actors/PG bundles at the GCS level.
@@ -660,6 +738,10 @@ class GcsServer:
             if is_label and any(labels.get(k) != v for k, v in hard.items()):
                 continue
             avail = n["resources_available"]
+            infl = self._actor_inflight.get(nid)
+            if infl:
+                avail = {k: avail.get(k, 0.0) - infl.get(k, 0.0)
+                         for k in set(avail) | set(infl)}
             total = n["resources_total"]
             if all(avail.get(k, 0) >= v for k, v in resources.items()) and all(
                 total.get(k, 0) >= v for k, v in resources.items()
@@ -691,10 +773,102 @@ class GcsServer:
 
     async def _schedule_pending_actors(self):
         queue, self.pending_actor_queue = self.pending_actor_queue, []
+        if not queue:
+            return
+        # Pick nodes up front (synchronously — one consistent view), then
+        # drive creations grouped per node in batched LeaseWorkersForActors
+        # RPCs. Each batch runs as its own coroutine so a burst pipelines
+        # instead of paying sequential fork+register round-trips; the shared
+        # semaphore bounds total in-flight creations across pumps.
+        singles: list = []   # (actor_id, rec) that must go one-at-a-time
+        by_node: Dict[bytes, list] = {}
         for actor_id in queue:
             rec = self.actors.get(actor_id)
             if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
                 continue
+            spec = rec["creation_spec"]
+            strategy = spec.get("strategy", {})
+            if strategy.get("type") == "placement_group":
+                singles.append(actor_id)
+                continue
+            node_id = self._pick_node(spec["resources"], strategy)
+            if node_id is None:
+                self.pending_actor_queue.append(actor_id)
+                continue
+            infl = self._actor_inflight.setdefault(node_id, {})
+            for k, v in spec["resources"].items():
+                infl[k] = infl.get(k, 0.0) + v
+            # carry the reserved resources so the release matches the
+            # reservation even if the record mutates before the batch runs
+            by_node.setdefault(node_id, []).append(
+                (actor_id, dict(spec["resources"]))
+            )
+        tasks = [self._schedule_one_actor(a) for a in singles]
+        batch = RTPU_CONFIG.actor_creation_lease_batch
+        for node_id, pairs in by_node.items():
+            for i in range(0, len(pairs), batch):
+                tasks.append(self._lease_actor_batch(node_id, pairs[i:i + batch]))
+        if tasks:
+            await asyncio.gather(*tasks)
+
+    def _release_inflight(self, node_id: bytes, resources: Dict[str, float]):
+        infl = self._actor_inflight.get(node_id)
+        if infl is None:
+            return
+        for k, v in resources.items():
+            infl[k] = infl.get(k, 0.0) - v
+            if infl[k] <= 0:
+                infl.pop(k, None)
+        if not infl:
+            self._actor_inflight.pop(node_id, None)
+
+    async def _lease_actor_batch(self, node_id: bytes, pairs: list):
+        """One LeaseWorkersForActors RPC creating a batch of actors on one
+        node (each still forks its own worker raylet-side, concurrently).
+        `pairs` is [(actor_id, reserved_resources)]."""
+        async with self._actor_create_sem:
+            items, recs = [], []
+            for actor_id, reserved in pairs:
+                rec = self.actors.get(actor_id)
+                if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
+                    self._release_inflight(node_id, reserved)
+                    continue
+                spec = rec["creation_spec"]
+                items.append({
+                    "actor_id": actor_id,
+                    "job_id": spec["job_id"],
+                    "resources": spec["resources"],
+                    "strategy": spec.get("strategy", {}),
+                    "runtime_env": spec.get("runtime_env", {}),
+                    "spec": spec,
+                })
+                recs.append((actor_id, rec, reserved))
+            if not items:
+                return
+            try:
+                raylet = await self._raylet_client(node_id)
+                reply = await raylet.call(
+                    "LeaseWorkersForActors", {"items": items},
+                    timeout=RTPU_CONFIG.worker_startup_timeout_s,
+                )
+                results = reply["results"]
+            except Exception as e:
+                logger.warning("actor lease batch on %s failed: %s",
+                               node_id.hex(), e)
+                results = [{"granted": False}] * len(recs)
+            for (actor_id, rec, reserved), res in zip(recs, results):
+                self._release_inflight(node_id, reserved)
+                done = await self._apply_lease_reply(actor_id, rec, node_id, res)
+                if not done and self.actors.get(actor_id, {}).get("state") in (
+                    PENDING_CREATION, RESTARTING,
+                ):
+                    self.pending_actor_queue.append(actor_id)
+
+    async def _schedule_one_actor(self, actor_id: bytes):
+        async with self._actor_create_sem:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
+                return
             ok = await self._try_create_actor(actor_id, rec)
             if not ok and self.actors.get(actor_id, {}).get("state") in (
                 PENDING_CREATION,
@@ -711,10 +885,24 @@ class GcsServer:
                 return False
             bundle = pg["bundles"][strategy.get("bundle_index") or 0]
             node_id = bundle["node_id"]
-        else:
-            node_id = self._pick_node(spec["resources"], strategy)
+            # PG actors draw from bundle pools already reserved by the 2PC,
+            # not from the node's free pool — no inflight tracking needed.
+            return await self._create_actor_on(actor_id, rec, node_id)
+        node_id = self._pick_node(spec["resources"], strategy)
         if node_id is None:
             return False
+        infl = self._actor_inflight.setdefault(node_id, {})
+        for k, v in spec["resources"].items():
+            infl[k] = infl.get(k, 0.0) + v
+        try:
+            return await self._create_actor_on(actor_id, rec, node_id)
+        finally:
+            self._release_inflight(node_id, spec["resources"])
+
+    async def _create_actor_on(self, actor_id: bytes, rec: dict,
+                               node_id: bytes) -> bool:
+        spec = rec["creation_spec"]
+        strategy = spec.get("strategy", {})
         try:
             raylet = await self._raylet_client(node_id)
             reply = await raylet.call(
@@ -725,12 +913,23 @@ class GcsServer:
                     "resources": spec["resources"],
                     "strategy": strategy,
                     "runtime_env": spec.get("runtime_env", {}),
+                    # Full creation spec: the raylet initializes the actor
+                    # during worker boot and replies created=True, saving the
+                    # GCS a per-actor connection + CreateActor round-trip.
+                    "spec": spec,
                 },
                 timeout=RTPU_CONFIG.worker_startup_timeout_s,
             )
         except Exception as e:
             logger.warning("actor lease on %s failed: %s", node_id.hex(), e)
             return False
+        return await self._apply_lease_reply(actor_id, rec, node_id, reply)
+
+    async def _apply_lease_reply(self, actor_id: bytes, rec: dict,
+                                 node_id: bytes, reply: dict) -> bool:
+        """Process a (possibly batched) lease reply; True = terminal state
+        reached (ALIVE or DEAD), False = retry later."""
+        spec = rec["creation_spec"]
         if not reply.get("granted"):
             if reply.get("error"):
                 # Deterministic failure (e.g. runtime_env setup): retrying
@@ -743,21 +942,25 @@ class GcsServer:
             return False
         worker_addr = tuple(reply["worker_addr"])
         worker_id = reply["worker_id"]
-        try:
-            worker = await self.pool.get(*worker_addr)
-            result = await worker.call(
-                "CreateActor", {"spec": spec, "actor_id": actor_id},
-                timeout=RTPU_CONFIG.worker_startup_timeout_s,
-            )
-        except Exception as e:
-            logger.warning("actor creation on %s failed: %s", node_id.hex(), e)
-            return False
-        if not result.get("ok"):
-            # Creation raised in __init__: actor is DEAD with the error recorded.
-            rec["state"] = DEAD
-            rec["death_cause"] = result.get("error", "creation failed")
-            self._publish_actor(actor_id, rec)
-            return True
+        if not reply.get("created"):
+            # Fallback (raylet didn't create during the lease): drive
+            # CreateActor over a direct connection as before.
+            try:
+                worker = await self.pool.get(*worker_addr)
+                result = await worker.call(
+                    "CreateActor", {"spec": spec, "actor_id": actor_id},
+                    timeout=RTPU_CONFIG.worker_startup_timeout_s,
+                )
+            except Exception as e:
+                logger.warning("actor creation on %s failed: %s", node_id.hex(), e)
+                return False
+            if not result.get("ok"):
+                # Creation raised in __init__: actor is DEAD with the error
+                # recorded.
+                rec["state"] = DEAD
+                rec["death_cause"] = result.get("error", "creation failed")
+                self._publish_actor(actor_id, rec)
+                return True
         rec.update(
             state=ALIVE, node_id=node_id, worker_id=worker_id, addr=list(worker_addr)
         )
@@ -868,6 +1071,14 @@ class GcsServer:
         actor_id = req["actor_id"]
         rec = self.actors.get(actor_id)
         if rec is None:
+            # Batched (async) registration can arrive AFTER a kill issued
+            # right behind `.remote()` on another connection. Tombstone the
+            # id so the late registration lands DEAD instead of leaking a
+            # live, unkillable actor.
+            if req.get("no_restart", True):
+                self._kill_tombstones.add(actor_id)
+                while len(self._kill_tombstones) > 10_000:
+                    self._kill_tombstones.pop()
             return {"ok": False}
         no_restart = req.get("no_restart", True)
         if no_restart:
@@ -1236,6 +1447,8 @@ def main(argv=None):
     parser.add_argument("--port-file", default="")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from ray_tpu._private.proc_profile import maybe_enable_process_profile
+    maybe_enable_process_profile("gcs")
 
     async def run():
         server = GcsServer(args.host, args.session_dir)

@@ -37,6 +37,8 @@ from ray_tpu._private.raylet.resources import ResourceSet
 from ray_tpu._private.raylet.worker_pool import WorkerPool
 from ray_tpu._private.rpc import ClientPool, RpcServer
 
+import msgpack
+
 logger = logging.getLogger("ray_tpu.raylet")
 
 
@@ -94,6 +96,8 @@ class NodeManager:
         self.bundles: Dict[Tuple[bytes, int], dict] = {}
         # worker_id -> actor_id for dedicated actor workers
         self._actor_workers: Dict[bytes, bytes] = {}
+        self._job_sys_path_cache: Dict[bytes, list] = {}
+        self._fn_blob_cache: Dict[bytes, bytes] = {}
         # cluster view: node_id -> info (from GCS)
         self.cluster_view: Dict[bytes, dict] = {}
         self._autoscaler_active = False
@@ -496,6 +500,11 @@ class NodeManager:
         token = req.get("startup_token", -1)
         if token >= 0:
             self.worker_pool.on_worker_registered(token, req["worker_id"], addr)
+        if "actor_result" in req:
+            # spawn-time actor creation result riding the registration
+            self.worker_pool.on_actor_created(
+                req["worker_id"], token, req.get("actor_result") or {}
+            )
         return {
             "node_id": self.node_id.binary(),
             "plasma_name": self.plasma_name,
@@ -704,7 +713,13 @@ class NodeManager:
     # --------------------------------------------------------------- actors
 
     async def handle_LeaseWorkerForActor(self, req):
-        """GCS asks us to supply a dedicated worker for an actor."""
+        """GCS asks us to supply a dedicated worker for an actor.
+
+        When the request carries the creation `spec`, the actor initializes
+        as part of the worker's boot (spec rides the fork-server spawn
+        message; the creation result rides the child's RegisterWorker
+        request) — collapsing the GCS's lease-then-create two-step, and its
+        per-actor TCP connection to the new worker, into this one RPC."""
         grant = self._try_acquire(req["resources"], req.get("strategy", {}))
         if grant is None:
             return {"granted": False}
@@ -719,7 +734,31 @@ class NodeManager:
         chips = self._allocate_chips(req["resources"].get("TPU", 0))
         if chips is not None:
             env.update(accelerators.visible_chip_env(chips))
-        handle = await self.worker_pool.pop_worker(req["job_id"], env or None)
+        spec = req.get("spec")
+        spawn_extra = {
+            "node_id": self.node_id.hex(),
+            "plasma_name": self.plasma_name,
+        }
+        sys_path = await self._job_sys_path(req["job_id"])
+        if sys_path is not None:
+            # None = transiently unknown: omit so the child runs its own
+            # GetJob fallback instead of trusting an empty path list.
+            spawn_extra["sys_path"] = sys_path
+        if spec is not None:
+            import base64
+
+            actor_payload = {
+                "spec_b64": base64.b64encode(
+                    msgpack.packb(spec, use_bin_type=True)
+                ).decode(),
+            }
+            fn_blob = await self._fn_blob(spec.get("fn_key"))
+            if fn_blob is not None:
+                actor_payload["fn_blob_b64"] = base64.b64encode(fn_blob).decode()
+            spawn_extra["actor"] = actor_payload
+        handle = await self.worker_pool.pop_worker(
+            req["job_id"], env or None, spawn_extra
+        )
         if handle is None:
             pool, _ = self._pool_for(req.get("strategy", {}))
             pool.release(grant["demand"])
@@ -727,6 +766,40 @@ class NodeManager:
                 self._free_chips.extend(chips)
                 self._free_chips.sort()
             return {"granted": False}
+        created = False
+        create_error = ""
+        if spec is not None:
+            if handle.actor_ready is not None:
+                # spawn-time creation: result already reported by the child
+                result = handle.actor_result or {}
+                created = bool(result.get("ok"))
+                create_error = result.get("error", "")
+            else:
+                # idle-worker reuse: drive CreateActor ourselves
+                try:
+                    client = await self.pool.get(*handle.addr)
+                    result = await client.call(
+                        "CreateActor",
+                        {"spec": spec, "actor_id": req["actor_id"]},
+                        timeout=RTPU_CONFIG.worker_startup_timeout_s,
+                    )
+                    created = bool(result.get("ok"))
+                    create_error = result.get("error", "")
+                except Exception as e:
+                    created, create_error = False, ""
+                    logger.warning("CreateActor on reused worker failed: %s", e)
+            if not created:
+                # creation failed: release everything; a deterministic
+                # __init__ error propagates so the GCS marks the actor DEAD
+                await self.worker_pool.kill_worker(handle)
+                pool, _ = self._pool_for(req.get("strategy", {}))
+                pool.release(grant["demand"])
+                if chips:
+                    self._free_chips.extend(chips)
+                    self._free_chips.sort()
+                if create_error:
+                    return {"granted": False, "error": create_error}
+                return {"granted": False}
         self._lease_seq += 1
         lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
         handle.lease_id = lease_id
@@ -740,10 +813,61 @@ class NodeManager:
         self._actor_workers[handle.worker_id] = req["actor_id"]
         return {
             "granted": True,
+            "created": created,
             "worker_addr": list(handle.addr),
             "worker_id": handle.worker_id,
             "lease_id": lease_id,
         }
+
+    async def handle_LeaseWorkersForActors(self, req):
+        """Batched actor lease: one RPC from the GCS creates N actors on
+        this node; each item forks+boots concurrently raylet-side."""
+        results = await asyncio.gather(
+            *(self.handle_LeaseWorkerForActor(item) for item in req["items"]),
+            return_exceptions=True,
+        )
+        out = []
+        for r in results:
+            if isinstance(r, BaseException):
+                logger.warning("batched actor lease item failed: %r", r)
+                out.append({"granted": False})
+            else:
+                out.append(r)
+        return {"results": out}
+
+    async def _job_sys_path(self, job_id: bytes) -> "Optional[list]":
+        """driver_sys_path for a job, fetched from the GCS once and cached —
+        saves every spawned worker its own GetJob round-trip."""
+        cached = self._job_sys_path_cache.get(job_id)
+        if cached is not None:
+            return cached
+        try:
+            reply = await self.gcs.call("GetJob", {"job_id": job_id})
+            paths = reply.get("job", {}).get("driver_sys_path", []) or []
+        except Exception:
+            return None  # transient: don't cache, let the child fall back
+        self._job_sys_path_cache[job_id] = paths
+        return paths
+
+    async def _fn_blob(self, fn_key) -> "Optional[bytes]":
+        """Actor-class blob from the GCS function table, cached per key so a
+        burst of same-class actors ships the class in the spawn message
+        instead of each child fetching it."""
+        if not fn_key:
+            return None
+        blob = self._fn_blob_cache.get(fn_key)
+        if blob is None:
+            try:
+                r = await self.gcs.call("KVGet", {"ns": "fn", "key": fn_key})
+            except Exception:
+                return None
+            blob = r.get("value")
+            if blob is None:
+                return None
+            if len(self._fn_blob_cache) > 128:
+                self._fn_blob_cache.clear()
+            self._fn_blob_cache[fn_key] = blob
+        return blob
 
     async def _materialize_uri(self, uri: str) -> str:
         """Fetch + extract a kv:<hash> packaged directory (idempotent)."""
@@ -1173,7 +1297,10 @@ class NodeManager:
                 return False
 
         while True:
-            await asyncio.sleep(0.25)
+            # Adaptive cadence: each pass stats every tracked file, so at
+            # many-worker scale a fixed 250 ms tick becomes thousands of
+            # stat()s per second of pure overhead.
+            await asyncio.sleep(0.25 if len(tracked) < 400 else 1.0)
             try:
                 now = time.time()
                 live_paths = set()
@@ -1688,6 +1815,8 @@ def main(argv=None):
     parser.add_argument("--port-file", default="")
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    from ray_tpu._private.proc_profile import maybe_enable_process_profile
+    maybe_enable_process_profile("raylet")
 
     import json
 

@@ -43,6 +43,10 @@ class WorkerHandle:
     # (reference: worker_pool.h keys pooled workers by runtime_env_hash)
     env_key: str = ""
     log_prefix: str = ""  # session-dir path stem of this worker's .out/.err
+    # actor-in-spawn fast path: set when the spawn message carried an actor
+    # spec; the creation result arrives inside the child's RegisterWorker
+    actor_ready: Optional[asyncio.Event] = None
+    actor_result: Optional[dict] = None
 
 
 class WorkerPool:
@@ -161,6 +165,8 @@ class WorkerPool:
             self._idle.remove(handle)
         if handle.register_event is not None:
             handle.register_event.set()
+        if handle.actor_ready is not None:
+            handle.actor_ready.set()
         if self._on_worker_death_cb is not None:
             asyncio.ensure_future(self._on_worker_death_cb(handle))
 
@@ -178,7 +184,9 @@ class WorkerPool:
         # must not let distinct environments collide onto one pooled worker.
         return json.dumps(sorted(env_overrides.items()))
 
-    async def start_worker(self, job_id: bytes, env_overrides=None) -> WorkerHandle:
+    async def start_worker(
+        self, job_id: bytes, env_overrides=None, spawn_extra: Optional[dict] = None
+    ) -> WorkerHandle:
         await self._ensure_fork_server()
         token = self._next_token
         self._next_token += 1
@@ -190,16 +198,17 @@ class WorkerPool:
         )
         handle.log_prefix = log_prefix
         self._starting[token] = handle
-        await self._fs_send(
-            {
-                "spawn": {
-                    "token": token,
-                    "job_id": job_id.hex(),
-                    "env": env_overrides or {},
-                    "log_prefix": log_prefix,
-                }
-            }
-        )
+        msg = {
+            "token": token,
+            "job_id": job_id.hex(),
+            "env": env_overrides or {},
+            "log_prefix": log_prefix,
+        }
+        if spawn_extra:
+            msg.update(spawn_extra)
+            if "actor" in spawn_extra:
+                handle.actor_ready = asyncio.Event()
+        await self._fs_send({"spawn": msg})
         return handle
 
     def on_worker_registered(
@@ -215,8 +224,17 @@ class WorkerPool:
         handle.register_event.set()
         return handle
 
-    async def pop_worker(self, job_id: bytes, env_overrides=None) -> Optional[WorkerHandle]:
-        """Get an idle worker for the job or fork a fresh one. Awaits registration."""
+    async def pop_worker(
+        self, job_id: bytes, env_overrides=None, spawn_extra: Optional[dict] = None
+    ) -> Optional[WorkerHandle]:
+        """Get an idle worker for the job or fork a fresh one. Awaits
+        registration — or, when `spawn_extra` carries an actor spec, the
+        creation result folded into the child's RegisterWorker request (the
+        actor initializes during boot, so the lease path pays one
+        round-trip instead of lease+create).
+
+        An idle hit returns a registered worker with `actor_ready is None`;
+        the caller then drives CreateActor over RPC itself."""
         env_key = self._env_key(env_overrides)
         for i, h in enumerate(self._idle):
             if h.job_id == job_id and h.alive and h.env_key == env_key:
@@ -224,14 +242,15 @@ class WorkerPool:
                 h.leased = True
                 return h
         try:
-            handle = await self.start_worker(job_id, env_overrides)
+            handle = await self.start_worker(job_id, env_overrides, spawn_extra)
         except Exception:
             # fork server failed to start or its stdin pipe broke; callers
             # (lease handlers) must release their resource grants on None.
             return None
+        wait_event = handle.actor_ready or handle.register_event
         try:
             await asyncio.wait_for(
-                handle.register_event.wait(), RTPU_CONFIG.worker_startup_timeout_s
+                wait_event.wait(), RTPU_CONFIG.worker_startup_timeout_s
             )
         except asyncio.TimeoutError:
             await self.kill_worker(handle)
@@ -240,6 +259,16 @@ class WorkerPool:
             return None
         handle.leased = True
         return handle
+
+    def on_actor_created(self, worker_id: bytes, startup_token: int,
+                         result: dict):
+        """Spawn-time actor creation outcome (from RegisterWorker)."""
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            handle = self._starting.get(startup_token)
+        if handle is not None and handle.actor_ready is not None:
+            handle.actor_result = result
+            handle.actor_ready.set()
 
     def push_idle(self, handle: WorkerHandle):
         handle.leased = False

@@ -53,6 +53,8 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "KVKeys": {"ns": (bytes, str), "prefix?": (bytes, str)},
     "KVExists": {"ns": (bytes, str), "key": (bytes, str)},
     "Subscribe": {"sub_id": bytes, "channel": str},
+    "SubscribeMany": {"sub_id": bytes, "channels": list},
+    "RegisterActors": {"items": list},
     "Unsubscribe": {"sub_id": bytes, "channel?": str},
     "PubsubPoll": {"sub_id": bytes, "timeout?": _num},
     "Publish": {"channel": str, "message": object},
@@ -87,7 +89,8 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
 
 RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "RegisterWorker": {"worker_id": bytes, "port": int,
-                       "startup_token?": int},
+                       "startup_token?": int,
+                       "actor_result?": dict},
     "RequestWorkerLease": {"job_id": bytes, "resources?": dict,
                            "strategy?": dict,
                            "runtime_env?": (dict, type(None))},
@@ -95,7 +98,9 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "GetNodeInfo": {},
     "LeaseWorkerForActor": {"actor_id": bytes, "job_id": bytes,
                             "resources": dict, "strategy?": dict,
-                            "runtime_env?": (dict, type(None))},
+                            "runtime_env?": (dict, type(None)),
+                            "spec?": dict},
+    "LeaseWorkersForActors": {"items": list},
     "KillWorker": {"worker_id": bytes, "reason?": str},
     "JobFinished": {"job_id": bytes},
     "PrepareBundle": {"pg_id": bytes, "bundle_index": int,

@@ -201,8 +201,22 @@ class CoreWorker:
         startup_token: int = -1,
         session_dir: str = "",
         host: str = "127.0.0.1",
+        driver_sys_path: Optional[List[str]] = None,
+        node_id_hex: str = "",
+        plasma_name: str = "",
+        pre_register=None,
     ):
         self.mode = mode
+        # None = unknown (fetch via GetJob at connect); a list (possibly
+        # empty) = the raylet already resolved it into the spawn message.
+        self._driver_sys_path = driver_sys_path
+        # Node identity/plasma handed through the spawn message: the worker
+        # can attach the object store and run `pre_register` (spawn-time
+        # actor creation) BEFORE the RegisterWorker round-trip, letting the
+        # creation result ride the registration request itself.
+        self._node_id_hint = node_id_hex
+        self._plasma_name_hint = plasma_name
+        self._pre_register = pre_register
         self.job_id = job_id
         self.worker_id = WorkerID.from_random()
         self.host = host
@@ -241,6 +255,7 @@ class CoreWorker:
         self._pending_tasks: Dict[bytes, dict] = {}  # task_id -> record
         self._actor_submitters: Dict[bytes, _ActorSubmitter] = {}
         self._subscribed_channels: set = set()
+        self._pubsub_task = None  # started lazily on first subscription
         self._working_dir_uris: Dict[tuple, str] = {}  # (path, signature) -> kv uri
         self._running_async: Dict[bytes, Any] = {}  # task_id -> cancellable future
         self._object_locations: Dict[bytes, set] = {}  # owned plasma obj -> node ids
@@ -286,41 +301,58 @@ class CoreWorker:
             # task: by-reference-pickled functions live in modules the driver
             # can import, and fork-server children don't inherit the driver's
             # path (reference: job_config code-search-path propagation).
-            try:
-                reply = await self.gcs_aio.call(
-                    "GetJob", {"job_id": self.job_id.binary()}
-                )
-                import sys as _sys
+            # The raylet resolves it once per job and passes it through the
+            # spawn message; only fall back to GetJob when it didn't.
+            paths = self._driver_sys_path
+            if paths is None:
+                try:
+                    reply = await self.gcs_aio.call(
+                        "GetJob", {"job_id": self.job_id.binary()}
+                    )
+                    paths = reply.get("job", {}).get("driver_sys_path", [])
+                except Exception:
+                    paths = []
+            import sys as _sys
 
-                for p in reply.get("job", {}).get("driver_sys_path", []):
-                    if p not in _sys.path:
-                        _sys.path.append(p)
-            except Exception:
-                pass
+            for p in paths:
+                if p not in _sys.path:
+                    _sys.path.append(p)
         self.raylet = RpcClient(*self._raylet_addr)
         await self.raylet.connect()
-        reply = await self.raylet.call(
-            "RegisterWorker",
-            {
-                "worker_id": self.worker_id.binary(),
-                "port": self.port,
-                "pid": os.getpid(),
-                "startup_token": self._startup_token,
-                "job_id": self.job_id.binary(),
-            },
-        )
-        self.node_id = NodeID(reply["node_id"])
-        self.plasma = PlasmaClient(reply["plasma_name"])
         self.address = (self.host, self.port)
+        register_req = {
+            "worker_id": self.worker_id.binary(),
+            "port": self.port,
+            "pid": os.getpid(),
+            "startup_token": self._startup_token,
+            "job_id": self.job_id.binary(),
+        }
+        if self._node_id_hint and self._plasma_name_hint:
+            # Spawn message already identified the node: attach plasma now so
+            # spawn-time actor creation can resolve plasma args, and fold the
+            # creation result into the registration round-trip.
+            self.node_id = NodeID.from_hex(self._node_id_hint)
+            self.plasma = PlasmaClient(self._plasma_name_hint)
+            if self._pre_register is not None:
+                register_req["actor_result"] = await self._pre_register(self)
+                # single-use: drop the closure (it pins the spec + b64 class
+                # blob for the worker's whole lifetime otherwise)
+                self._pre_register = None
+            reply = await self.raylet.call("RegisterWorker", register_req)
+        else:
+            reply = await self.raylet.call("RegisterWorker", register_req)
+            self.node_id = NodeID(reply["node_id"])
+            self.plasma = PlasmaClient(reply["plasma_name"])
         asyncio.ensure_future(self._task_event_flush_loop())
-        asyncio.ensure_future(self._pubsub_loop())
         if self.mode == MODE_WORKER:
             asyncio.ensure_future(self._watch_raylet())
 
     async def _watch_raylet(self):
-        """Workers die with their raylet (reference: worker <-> raylet socket)."""
+        """Workers die with their raylet (reference: worker <-> raylet
+        socket). 2s cadence: at 1000-worker scale every idle per-worker
+        timer is a process wakeup stealing the core from real work."""
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(2.0)
             if not self.raylet.is_connected():
                 os._exit(1)
             if os.getppid() == 1:
@@ -328,14 +360,20 @@ class CoreWorker:
 
     async def _task_event_flush_loop(self):
         period = RTPU_CONFIG.task_events_flush_period_ms / 1000.0
+        idle_period = period
         while True:
-            await asyncio.sleep(period)
+            await asyncio.sleep(idle_period)
             events = self.task_events.drain()
             if events:
+                idle_period = period
                 try:
                     await self.gcs_aio.notify("AddTaskEvents", {"events": events})
                 except Exception:
                     pass
+            else:
+                # Idle worker: back off (cap 8x) — a fleet of parked actors
+                # shouldn't generate a constant wakeup storm.
+                idle_period = min(idle_period * 2, period * 8)
             self._flush_user_metrics()
 
     def _flush_user_metrics(self):
@@ -426,12 +464,15 @@ class CoreWorker:
         normal_states: Dict[tuple, _LeaseState] = {}
         actor_subs: Dict[bytes, _ActorSubmitter] = {}
         frees: list = []
+        actor_regs: list = []
         for kind, item in work:
             if kind == "normal":
                 key = ts.scheduling_key(item)
                 state = self._leases.setdefault(key, _LeaseState())
                 state.queue.append(item)
                 normal_states[key] = state
+            elif kind == "register_actor":
+                actor_regs.append(item)
             elif kind == "actor":
                 actor_id, spec = item
                 sub = self._route_actor_spec(actor_id, spec)
@@ -450,6 +491,43 @@ class CoreWorker:
             self._pump_actor(sub)
         if frees:
             asyncio.ensure_future(self._free_refs_batch(frees))
+        if actor_regs:
+            asyncio.ensure_future(self._register_actors_batch(actor_regs))
+
+    async def _register_actors_batch(self, items):
+        """One SubscribeMany + one RegisterActors round-trip for a burst of
+        anonymous actor creations. Subscribing first closes the
+        missed-publish window without a per-actor state refresh."""
+        channels = []
+        for actor_id, _payload in items:
+            ch = f"actor:{actor_id.hex()}"
+            self._subscribed_channels.add(ch)
+            channels.append(ch)
+        self._ensure_pubsub()
+        # Retry: registration is server-side idempotent, so a dropped reply
+        # or GCS failover must not double-jeopardize actors the GCS already
+        # registered (persisted + scheduled) by declaring them DEAD here.
+        last_err = None
+        for attempt in range(3):
+            if attempt:
+                await asyncio.sleep(1.0 * attempt)
+            try:
+                await self.gcs_aio.call(
+                    "SubscribeMany",
+                    {"sub_id": self.worker_id.binary(), "channels": channels},
+                )
+                await self.gcs_aio.call(
+                    "RegisterActors", {"items": [p for _, p in items]}
+                )
+                return
+            except Exception as e:
+                last_err = e
+        for actor_id, _payload in items:
+            sub = self._actor_submitters.get(actor_id)
+            if sub is not None:
+                rec = {"state": "DEAD", "addr": None,
+                       "death_cause": f"actor registration failed: {last_err}"}
+                await self._apply_actor_state(sub, rec)
 
     async def _notify_owner(self, owner_addr, method, payload):
         try:
@@ -1433,20 +1511,36 @@ class CoreWorker:
         sub = _ActorSubmitter(actor_id.binary())
         sub.state = "PENDING_CREATION"
         self._actor_submitters[actor_id.binary()] = sub
-        self.gcs.call(
-            "RegisterActor",
-            {
-                "actor_id": actor_id.binary(),
-                "creation_spec": spec,
-                "name": name,
-                "namespace": namespace,
-                "max_restarts": max_restarts,
-                "detached": lifetime == "detached",
-            },
-        )
-        self.io.post(self._watch_actor(actor_id.binary()))
         # keep creation arg refs alive until ALIVE (bound to submitter)
         sub.creation_refs = refs  # type: ignore[attr-defined]
+        payload = {
+            "actor_id": actor_id.binary(),
+            "creation_spec": spec,
+            "name": name,
+            "namespace": namespace,
+            "max_restarts": max_restarts,
+            "detached": lifetime == "detached",
+        }
+        if name:
+            # Named actors keep the synchronous round-trip: a name collision
+            # must raise ValueError at .remote() time (reference:
+            # actor.py _remote raising on duplicate detached names).
+            try:
+                self.gcs.call("RegisterActor", payload)
+            except Exception as e:
+                if "already taken" in str(e):
+                    raise ValueError(
+                        f"actor name {name!r} already taken"
+                    ) from None
+                raise
+            self.io.post(self._watch_actor(actor_id.binary()))
+            return actor_id.binary()
+        # Anonymous actors register asynchronously and BATCHED: a burst of
+        # .remote() calls becomes one SubscribeMany + one RegisterActors
+        # round-trip instead of 3 per actor (subscribe-before-register makes
+        # the state watch race-free without a refresh read).
+        sub.watched = True
+        self._post_batched("register_actor", (actor_id.binary(), payload))
         return actor_id.binary()
 
     def submit_actor_task(
@@ -1685,21 +1779,33 @@ class CoreWorker:
         for line in msg.get("lines", []):
             print(f"{prefix} {line}", file=stream)
 
+    def _ensure_pubsub(self):
+        """Start the long-poll loop on first subscription. Workers that never
+        subscribe (the common short-lived task/actor worker) keep zero
+        standing GCS poll traffic — at many-worker scale the idle polls were
+        a measurable share of control-plane messages."""
+        if self._pubsub_task is None:
+            self._pubsub_task = asyncio.ensure_future(self._pubsub_loop())
+
     def enable_log_to_driver(self):
         """Stream worker stdout/stderr of this job to the driver."""
         channel = f"logs:{self.job_id.binary().hex()}"
         self._subscribed_channels.add(channel)
-        self.io.run(
-            self.gcs_aio.call(
+
+        async def _sub():
+            self._ensure_pubsub()
+            await self.gcs_aio.call(
                 "Subscribe",
                 {"sub_id": self.worker_id.binary(), "channel": channel},
             )
-        )
+
+        self.io.run(_sub())
 
     async def _watch_actor(self, actor_id: bytes):
         sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
         channel = f"actor:{actor_id.hex()}"
         self._subscribed_channels.add(channel)
+        self._ensure_pubsub()
         await self.gcs_aio.call(
             "Subscribe", {"sub_id": self.worker_id.binary(), "channel": channel}
         )

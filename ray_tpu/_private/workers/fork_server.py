@@ -95,6 +95,41 @@ def _child_main(args, spawn):
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.worker import MODE_WORKER, CoreWorker, set_global_worker
 
+    profile_dir = os.environ.get("RTPU_PROFILE_WORKER_BOOT")
+    prof = None
+    if profile_dir:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+    actor = spawn.get("actor")
+    pre_register = None
+    if actor:
+        # Actor-in-spawn fast path: the lease carried the creation spec, so
+        # the actor initializes during boot — before RegisterWorker — and
+        # the result rides the registration request. No separate GCS->worker
+        # connection, CreateActor round-trip, or ActorCreated report.
+        import base64
+
+        import msgpack
+
+        spec = msgpack.unpackb(
+            base64.b64decode(actor["spec_b64"]), raw=False, strict_map_key=False
+        )
+        fn_blob = actor.get("fn_blob_b64")
+
+        async def pre_register(worker):
+            try:
+                if fn_blob:
+                    # inside the try: an unpicklable class blob must surface
+                    # as a creation error, not crash the child pre-register
+                    worker.functions.seed(
+                        spec["fn_key"], base64.b64decode(fn_blob)
+                    )
+                return await worker.executor.create_actor(spec, spec["actor_id"])
+            except Exception as e:
+                return {"ok": False, "error": repr(e)}
+
     worker = CoreWorker(
         mode=MODE_WORKER,
         gcs_address=args.gcs_address,
@@ -103,8 +138,15 @@ def _child_main(args, spawn):
         startup_token=spawn["token"],
         session_dir=args.session_dir,
         host=args.raylet_host,
+        driver_sys_path=spawn.get("sys_path"),
+        node_id_hex=spawn.get("node_id", ""),
+        plasma_name=spawn.get("plasma_name", ""),
+        pre_register=pre_register,
     )
     set_global_worker(worker)
+    if prof is not None:
+        prof.disable()
+        prof.dump_stats(os.path.join(profile_dir, f"boot-{os.getpid()}.prof"))
     threading.Event().wait()
 
 
@@ -117,10 +159,23 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     # Pay the import bill once, before any fork.
+    import base64  # noqa: F401
+
+    import msgpack  # noqa: F401
     import numpy  # noqa: F401
 
     import ray_tpu._private.executor  # noqa: F401
+    import ray_tpu._private.schema  # noqa: F401
     import ray_tpu._private.worker  # noqa: F401
+
+    # dlopen the plasma client library once pre-fork — children inherit the
+    # mapping (the module memoizes in a global), saving ~1 ms per spawn.
+    try:
+        from ray_tpu._native import plasma as _plasma
+
+        _plasma._load()
+    except Exception:
+        pass
 
     out_lock = threading.Lock()
     signal.signal(signal.SIGCHLD, signal.SIG_DFL)

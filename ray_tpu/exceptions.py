@@ -35,6 +35,12 @@ class ActorDiedError(RayTpuError):
         self.actor_id = actor_id
         super().__init__(msg)
 
+    def __reduce__(self):
+        # Exception pickling replays __init__ with self.args, which holds
+        # only (msg,) — without this the death cause would land in actor_id
+        # and the message reset to the default after any serialization hop.
+        return (type(self), (self.actor_id, str(self)))
+
 
 class ActorUnavailableError(RayTpuError):
     pass
@@ -60,6 +66,9 @@ class TaskCancelledError(RayTpuError):
     def __init__(self, task_id=None):
         self.task_id = task_id
         super().__init__("task was cancelled")
+
+    def __reduce__(self):
+        return (type(self), (self.task_id,))
 
 
 class PendingCallsLimitExceeded(RayTpuError):
