@@ -849,7 +849,11 @@ class GcsServer:
                 raylet = await self._raylet_client(node_id)
                 reply = await raylet.call(
                     "LeaseWorkersForActors", {"items": items},
-                    timeout=RTPU_CONFIG.worker_startup_timeout_s,
+                    # margin over the raylet's own per-item startup wait:
+                    # if one slow fork hits that limit, the raylet must get
+                    # to report the siblings it DID lease, or their grants
+                    # and __init__ side effects would leak/duplicate
+                    timeout=RTPU_CONFIG.worker_startup_timeout_s + 30.0,
                 )
                 results = reply["results"]
             except Exception as e:
@@ -918,7 +922,7 @@ class GcsServer:
                     # GCS a per-actor connection + CreateActor round-trip.
                     "spec": spec,
                 },
-                timeout=RTPU_CONFIG.worker_startup_timeout_s,
+                timeout=RTPU_CONFIG.worker_startup_timeout_s + 30.0,
             )
         except Exception as e:
             logger.warning("actor lease on %s failed: %s", node_id.hex(), e)
@@ -942,6 +946,18 @@ class GcsServer:
             return False
         worker_addr = tuple(reply["worker_addr"])
         worker_id = reply["worker_id"]
+        if rec["state"] == DEAD:
+            # kill() landed while the lease was in flight: don't resurrect —
+            # tear down the worker the raylet just granted.
+            try:
+                raylet = await self._raylet_client(node_id)
+                await raylet.notify(
+                    "KillWorker",
+                    {"worker_id": worker_id, "reason": "actor killed during creation"},
+                )
+            except Exception:
+                pass
+            return True
         if not reply.get("created"):
             # Fallback (raylet didn't create during the lease): drive
             # CreateActor over a direct connection as before.

@@ -146,7 +146,11 @@ def _child_main(args, spawn):
     set_global_worker(worker)
     if prof is not None:
         prof.disable()
-        prof.dump_stats(os.path.join(profile_dir, f"boot-{os.getpid()}.prof"))
+        try:
+            os.makedirs(profile_dir, exist_ok=True)
+            prof.dump_stats(os.path.join(profile_dir, f"boot-{os.getpid()}.prof"))
+        except Exception:
+            pass  # diagnostics must never kill the worker
     threading.Event().wait()
 
 

@@ -135,8 +135,16 @@ class TrainStep:
         self.batch_sharding = batch_sharding(mesh)
 
         def init_fn(rng):
-            T = min(8, model_cfg.block_size)
-            idx = jnp.zeros((2, T), dtype=jnp.int32)
+            # Dummy batch for shape inference must still satisfy the mesh:
+            # B divisible by dp*fsdp, T by sp (ring attention shard_maps
+            # over them even during init).
+            data = 1
+            for a in ("dp", "fsdp"):
+                if a in mesh.shape:
+                    data *= mesh.shape[a]
+            sp = mesh.shape.get("sp", 1)
+            T = min(8 * sp, model_cfg.block_size)
+            idx = jnp.zeros((max(2, data), T), dtype=jnp.int32)
             params = self.model.init(rng, idx)["params"]
             return {
                 "params": params,
