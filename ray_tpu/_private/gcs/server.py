@@ -934,6 +934,21 @@ class GcsServer:
         """Process a (possibly batched) lease reply; True = terminal state
         reached (ALIVE or DEAD), False = retry later."""
         spec = rec["creation_spec"]
+        if rec["state"] == DEAD:
+            # kill() landed while the lease was in flight: don't resurrect
+            # (or overwrite the kill's death_cause with a lease error) —
+            # tear down any worker the raylet just granted.
+            if reply.get("granted"):
+                try:
+                    raylet = await self._raylet_client(node_id)
+                    await raylet.notify(
+                        "KillWorker",
+                        {"worker_id": reply["worker_id"],
+                         "reason": "actor killed during creation"},
+                    )
+                except Exception:
+                    pass
+            return True
         if not reply.get("granted"):
             if reply.get("error"):
                 # Deterministic failure (e.g. runtime_env setup): retrying
@@ -946,18 +961,6 @@ class GcsServer:
             return False
         worker_addr = tuple(reply["worker_addr"])
         worker_id = reply["worker_id"]
-        if rec["state"] == DEAD:
-            # kill() landed while the lease was in flight: don't resurrect —
-            # tear down the worker the raylet just granted.
-            try:
-                raylet = await self._raylet_client(node_id)
-                await raylet.notify(
-                    "KillWorker",
-                    {"worker_id": worker_id, "reason": "actor killed during creation"},
-                )
-            except Exception:
-                pass
-            return True
         if not reply.get("created"):
             # Fallback (raylet didn't create during the lease): drive
             # CreateActor over a direct connection as before.

@@ -971,6 +971,8 @@ class NodeManager:
     async def handle_KillWorker(self, req):
         handle = self.worker_pool.workers.get(req["worker_id"])
         if handle is not None:
+            if req.get("reason"):
+                self._kill_reasons[req["worker_id"]] = req["reason"]
             # death is reported once, by the fork server's reap (or the
             # liveness poll) — not here, to avoid double ReportWorkerDeath
             await self.worker_pool.kill_worker(handle)

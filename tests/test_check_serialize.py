@@ -4,6 +4,10 @@ import threading
 
 from ray_tpu.util.check_serialize import inspect_serializability
 
+import pytest
+
+pytestmark = pytest.mark.fast  # pure-unit: no cluster boot
+
 
 def test_serializable_object():
     ok, failures = inspect_serializability({"a": [1, 2], "b": "x"})

@@ -7,6 +7,8 @@ import pytest
 
 from ray_tpu._private import schema
 
+pytestmark = pytest.mark.fast  # pure-unit: no cluster boot
+
 
 def test_valid_payload_passes():
     schema.validate(schema.GCS_SCHEMAS, "KVPut",

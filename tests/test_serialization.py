@@ -7,6 +7,8 @@ from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_ref import ObjectRef
 
+pytestmark = pytest.mark.fast  # pure-unit: no cluster boot
+
 
 def roundtrip(value):
     p, bufs, refs = serialization.serialize(value)
