@@ -1135,10 +1135,22 @@ class GcsServer:
             "owner_worker_id": req.get("owner_worker_id"),
             "ready_event": None,
         }
-        self._persist_pg(self.placement_groups[pg_id])
-        self.pending_pg_queue.append(pg_id)
-        asyncio.ensure_future(self._schedule_pending_pgs())
-        return {"ok": True}
+        pg = self.placement_groups[pg_id]
+        self._persist_pg(pg)
+        # Inline first attempt of THIS group only (draining the whole
+        # pending queue here would serialize unrelated stuck groups into
+        # every create RPC): the ubiquitous create->ready() sequence learns
+        # CREATED from this reply and skips its wait round-trip. Infeasible
+        # groups fall through fast (placement returns None) and go pending.
+        try:
+            ok = await self._try_create_pg(pg_id, pg)
+        except Exception:
+            logger.exception("pg %s inline creation attempt failed",
+                             pg_id.hex())
+            ok = False
+        if not ok and pg["state"] in ("PENDING", "RESCHEDULING"):
+            self.pending_pg_queue.append(pg_id)
+        return {"ok": True, "state": pg["state"]}
 
     def _select_pg_nodes(self, pg) -> Optional[List[bytes]]:
         """Choose a node per bundle according to the PG strategy.
@@ -1222,55 +1234,88 @@ class GcsServer:
         placement = self._select_pg_nodes(pg)
         if placement is None:
             return False
-        # Phase 1: prepare (reserve) on each raylet, all bundles in parallel
-        # (2PC like reference gcs_placement_group_scheduler.h).
-        async def _prepare(bundle, node_id):
+        # Per-node bundle groups: one PrepareBundles + one CommitBundles RPC
+        # per raylet instead of one round-trip per bundle (2PC like
+        # reference gcs_placement_group_scheduler.h, batched).
+        by_node: Dict[bytes, list] = {}
+        for b, n in zip(pg["bundles"], placement):
+            by_node.setdefault(n, []).append(b)
+
+        # Phase 1: prepare (reserve), all nodes in parallel. A group hosted
+        # entirely by one raylet commits in the same RPC (single-participant
+        # 2PC degenerates to 1PC) and skips phase 2.
+        one_phase = len(by_node) == 1
+
+        async def _prepare_node(node_id, bundles):
             raylet = await self._raylet_client(node_id)
             r = await raylet.call(
-                "PrepareBundle",
-                {"pg_id": pg_id, "bundle_index": bundle["index"],
-                 "resources": bundle["resources"]},
+                "PrepareBundles",
+                {"items": [
+                    {"pg_id": pg_id, "bundle_index": b["index"],
+                     "resources": b["resources"]} for b in bundles
+                ], "commit": one_phase},
                 timeout=10,
             )
             return bool(r.get("ok"))
 
+        node_ids = list(by_node.keys())
         results = await asyncio.gather(
-            *(_prepare(b, n) for b, n in zip(pg["bundles"], placement)),
+            *(_prepare_node(n, by_node[n]) for n in node_ids),
             return_exceptions=True,
         )
         if not all(r is True for r in results):
-            # roll back every successfully-prepared bundle
-            async def _cancel(bundle, node_id):
+            # roll back every successfully-prepared node group (a failed
+            # PrepareBundles already rolled its own node back)
+            async def _cancel_node(node_id, bundles):
                 try:
                     raylet = await self._raylet_client(node_id)
-                    await raylet.notify(
-                        "CancelBundle",
-                        {"pg_id": pg_id, "bundle_index": bundle["index"]},
-                    )
+                    for b in bundles:
+                        await raylet.notify(
+                            "CancelBundle",
+                            {"pg_id": pg_id, "bundle_index": b["index"]},
+                        )
                 except Exception:
                     pass
 
             await asyncio.gather(*(
-                _cancel(b, n)
-                for (b, n), r in zip(zip(pg["bundles"], placement), results)
+                _cancel_node(n, by_node[n])
+                for n, r in zip(node_ids, results)
                 if r is True
             ))
             return False
 
+        if one_phase:
+            for n in node_ids:
+                for b in by_node[n]:
+                    b["node_id"] = n
+            pg["state"] = "CREATED"
+            self._persist_pg(pg)
+            if pg.get("ready_event") is not None:
+                pg["ready_event"].set()
+            self.pubsub.publish("pg", {"pg_id": pg_id, "state": "CREATED"})
+            asyncio.ensure_future(self._schedule_pending_actors())
+            return True
+
         # Phase 2: commit, in parallel. A commit failure (raylet died between
         # prepare and commit) must roll back the committed/prepared bundles
         # and report failure — NOT raise, or the whole pending queue is lost.
-        async def _commit(bundle, node_id):
+        async def _commit_node(node_id, bundles):
             raylet = await self._raylet_client(node_id)
-            await raylet.call(
-                "CommitBundle",
-                {"pg_id": pg_id, "bundle_index": bundle["index"]},
+            r = await raylet.call(
+                "CommitBundles",
+                {"items": [
+                    {"pg_id": pg_id, "bundle_index": b["index"]}
+                    for b in bundles
+                ]},
                 timeout=10,
             )
-            bundle["node_id"] = node_id
+            if not r.get("ok"):
+                raise RuntimeError(f"commit failed on {node_id.hex()}")
+            for b in bundles:
+                b["node_id"] = node_id
 
         commit_results = await asyncio.gather(
-            *(_commit(b, n) for b, n in zip(pg["bundles"], placement)),
+            *(_commit_node(n, by_node[n]) for n in node_ids),
             return_exceptions=True,
         )
         if any(isinstance(r, BaseException) for r in commit_results):

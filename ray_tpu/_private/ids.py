@@ -38,7 +38,10 @@ _UNIQUE_ID_SIZE = 14
 
 
 class BaseID:
-    __slots__ = ("_bytes",)
+    # _hash caches hash(_bytes): IDs key dicts all over the submit path
+    # (leases, pending tasks, refcounts) — hashing the bytes each lookup
+    # was a measurable share of driver io-thread time.
+    __slots__ = ("_bytes", "_hash")
     SIZE = _UNIQUE_ID_SIZE
 
     def __init__(self, id_bytes: bytes):
@@ -47,6 +50,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = bytes(id_bytes)
+        self._hash = hash(self._bytes)
 
     @classmethod
     def from_random(cls):
@@ -70,7 +74,7 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash(self._bytes)
+        return self._hash
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

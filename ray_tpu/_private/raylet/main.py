@@ -1031,6 +1031,32 @@ class NodeManager:
         bundle["committed"] = True
         return {"ok": True}
 
+    async def handle_PrepareBundles(self, req):
+        """Batched 2PC prepare: every bundle this node hosts in ONE RPC
+        (a 2-bundle PG on one node was 2 prepare + 2 commit round-trips).
+        All-or-nothing per node: partial acquisitions roll back here.
+        With `commit: true` (single-participant groups) the 2PC degenerates
+        to one phase — sole-node atomicity needs no separate commit."""
+        acquired = []
+        for item in req["items"]:
+            r = await self.handle_PrepareBundle(item)
+            if not r.get("ok"):
+                for done in acquired:
+                    await self._return_bundle(done)
+                return {"ok": False}
+            acquired.append(item)
+        if req.get("commit"):
+            for item in req["items"]:
+                await self.handle_CommitBundle(item)
+        return {"ok": True}
+
+    async def handle_CommitBundles(self, req):
+        ok = True
+        for item in req["items"]:
+            r = await self.handle_CommitBundle(item)
+            ok = ok and bool(r.get("ok"))
+        return {"ok": ok}
+
     async def handle_CancelBundle(self, req):
         await self._return_bundle(req)
 

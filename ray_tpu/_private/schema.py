@@ -106,6 +106,8 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "PrepareBundle": {"pg_id": bytes, "bundle_index": int,
                       "resources": dict},
     "CommitBundle": {"pg_id": bytes, "bundle_index": int},
+    "PrepareBundles": {"items": list, "commit?": bool},
+    "CommitBundles": {"items": list},
     "CancelBundle": {"pg_id?": bytes, "bundle_index?": int},
     "ReturnBundle": {"pg_id?": bytes, "bundle_index?": int},
     "SpillObjects": {"bytes": int},

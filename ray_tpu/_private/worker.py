@@ -111,23 +111,37 @@ class TaskEventBuffer:
         self._node_hex = ""
 
     def record(self, spec: dict, state: str, error: str = ""):
-        if not self._node_hex and self.core.node_id:
-            self._node_hex = self.core.node_id.hex()
-        ev = {
-            "task_id": spec["task_id"].hex() if isinstance(spec["task_id"], bytes) else spec["task_id"],
-            "name": spec.get("name", ""),
-            "job_id": spec.get("job_id", b"").hex() if isinstance(spec.get("job_id"), bytes) else "",
-            "state": state,
-            "ts": time.time(),
-            "node_id": self._node_hex,
-            "worker_id": self._worker_hex,
-            "error": error,
-            "actor_id": spec.get("actor_id", b"").hex() if spec.get("actor_id") else "",
-        }
+        # Hot path (2+ calls per task): capture only the small id fields in
+        # a tuple (holding the whole spec would pin its inline args until
+        # the next drain) and defer the dict build + hex conversions to
+        # drain() — the flush loop runs once a second, the submit path runs
+        # thousands of times a second.
+        ev = (
+            spec["task_id"], spec.get("name", ""), spec.get("job_id", b""),
+            spec.get("actor_id"), state, time.time(), error,
+        )
         with self._lock:
             self._events.append(ev)
             if len(self._events) > self._max_buffer:
                 del self._events[: len(self._events) // 2]
+
+    def _materialize(self, ev) -> dict:
+        if isinstance(ev, dict):  # span records are pre-built
+            return ev
+        task_id, name, job_id, actor_id, state, ts, error = ev
+        if not self._node_hex and self.core.node_id:
+            self._node_hex = self.core.node_id.hex()
+        return {
+            "task_id": task_id.hex() if isinstance(task_id, bytes) else task_id,
+            "name": name,
+            "job_id": job_id.hex() if isinstance(job_id, bytes) else "",
+            "state": state,
+            "ts": ts,
+            "node_id": self._node_hex,
+            "worker_id": self._worker_hex,
+            "error": error,
+            "actor_id": actor_id.hex() if actor_id else "",
+        }
 
     def record_span(
         self, name: str, start: float, end: float, ctx: dict,
@@ -158,7 +172,7 @@ class TaskEventBuffer:
     def drain(self) -> List[dict]:
         with self._lock:
             out, self._events = self._events, []
-        return out
+        return [self._materialize(ev) for ev in out]
 
 
 class _LeaseState:
@@ -1943,30 +1957,12 @@ class CoreWorker:
         return await self.executor.execute_normal(req["spec"])
 
     async def handle_PushTasks(self, req):
-        """Batched push: execute CONCURRENTLY (each task on its own thread),
-        reply in batch. Serial execution would deadlock tasks that
-        synchronize with each other (e.g. a barrier pair landing in one
-        batch); with one thread each they behave exactly as if they'd been
-        granted separate leases, which is the semantics batching must
-        preserve. The executor's persistent elastic pool supplies the
-        threads (creating a pool per RPC cost ~0.1 ms/thread)."""
-        specs = req["specs"]
-        pool = self.executor._batch_pool
-        # Preserve the old per-RPC-pool guarantee that every in-flight
-        # batched task owns a thread (tasks in a batch may synchronize with
-        # each other): grow the persistent pool's cap when concurrent
-        # batches would exhaust it. ThreadPoolExecutor only spawns threads
-        # on demand, so a high cap costs nothing until needed.
-        self.executor._batch_inflight += len(specs)
-        if self.executor._batch_inflight > pool._max_workers:
-            pool._max_workers = self.executor._batch_inflight + 16
-        try:
-            replies = await asyncio.gather(
-                *(self.executor._execute(spec, pool) for spec in specs)
-            )
-        finally:
-            self.executor._batch_inflight -= len(specs)
-        return {"replies": list(replies)}
+        """Batched push: one pooled thread executes the batch back-to-back,
+        spilling to thread-per-task only if a task blocks (executor
+        .execute_batch) — tasks that synchronize with a batch-mate still
+        behave as if they'd been granted separate leases, without paying a
+        threadpool round-trip per tiny task."""
+        return {"replies": await self.executor.execute_batch(req["specs"])}
 
     async def handle_CreateActor(self, req):
         return await self.executor.create_actor(req["spec"], req["actor_id"])

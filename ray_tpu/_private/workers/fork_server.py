@@ -144,6 +144,25 @@ def _child_main(args, spawn):
         pre_register=pre_register,
     )
     set_global_worker(worker)
+    secs = os.environ.get("RTPU_PROFILE_WORKER_SECS")
+    if secs and os.environ.get("RTPU_PROFILE_WORKER_BOOT"):
+        import cProfile as _cp
+
+        def _steady():
+            import time as _time
+
+            p = _cp.Profile()
+            p.enable()
+            _time.sleep(float(secs))
+            p.disable()
+            try:
+                p.dump_stats(os.path.join(
+                    os.environ["RTPU_PROFILE_WORKER_BOOT"],
+                    f"steady-{os.getpid()}.prof"))
+            except Exception:
+                pass
+
+        threading.Thread(target=_steady, daemon=True).start()
     if prof is not None:
         prof.disable()
         try:

@@ -16,9 +16,13 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 
 class PlacementGroup:
-    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]]):
+    def __init__(self, pg_id: bytes, bundles: List[Dict[str, float]],
+                 created: bool = False):
         self.id = pg_id
         self._bundles = bundles
+        # CreatePlacementGroup's reply carries the state when the GCS
+        # reserved the group inline; ready()/wait() then skip their RPC.
+        self._created = created
 
     @property
     def bundle_specs(self) -> List[Dict[str, float]]:
@@ -36,13 +40,16 @@ class PlacementGroup:
         return self
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
+        if self._created:
+            return True
         worker = get_global_worker()
         reply = worker.gcs.call(
             "WaitPlacementGroupReady",
             {"pg_id": self.id, "timeout": timeout_seconds},
             timeout=timeout_seconds + 5,
         )
-        return bool(reply.get("ready"))
+        self._created = bool(reply.get("ready"))
+        return self._created
 
     def __reduce__(self):
         return (PlacementGroup, (self.id, self._bundles))
@@ -63,7 +70,7 @@ def placement_group(
             raise ValueError(f"invalid bundle {b}")
     worker = get_global_worker()
     pg_id = PlacementGroupID.from_random().binary()
-    worker.gcs.call(
+    reply = worker.gcs.call(
         "CreatePlacementGroup",
         {
             "pg_id": pg_id,
@@ -79,7 +86,8 @@ def placement_group(
             ),
         },
     )
-    return PlacementGroup(pg_id, bundles)
+    return PlacementGroup(pg_id, bundles,
+                          created=reply.get("state") == "CREATED")
 
 
 def remove_placement_group(pg: PlacementGroup):
