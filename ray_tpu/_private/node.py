@@ -61,6 +61,7 @@ class Node:
         object_store_memory: Optional[int] = None,
         session_dir: Optional[str] = None,
         node_name: str = "",
+        gcs_port: int = 0,
     ):
         if not head and not gcs_address:
             raise ValueError("worker node requires gcs_address")
@@ -80,7 +81,7 @@ class Node:
         self._gcs_monitor: Optional[threading.Thread] = None
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         if head:
-            self._start_gcs()
+            self._start_gcs(port=gcs_port)
             self._gcs_monitor = threading.Thread(
                 target=self._monitor_gcs, name="gcs-monitor", daemon=True
             )
@@ -96,7 +97,9 @@ class Node:
 
     def _env(self):
         env = dict(os.environ)
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from ray_tpu._private import repo_root as _repo_root
+
+        repo_root = _repo_root()
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
         return env
 

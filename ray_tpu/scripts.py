@@ -30,7 +30,9 @@ import json
 import os
 import sys
 
-_STATE_FILE = os.path.join(
+# Overridable so a launcher driving several logical nodes on one machine
+# (fake multi-node e2e) can keep per-node state files.
+_STATE_FILE = os.environ.get("RTPU_STATE_FILE") or os.path.join(
     os.environ.get("TMPDIR", "/tmp"), "ray_tpu", "cli_cluster.json"
 )
 
@@ -50,7 +52,8 @@ def cmd_start(args):
 
     resources = json.loads(args.resources) if args.resources else None
     if args.head:
-        node = Node(head=True, resources=resources)
+        node = Node(head=True, resources=resources, host=args.host,
+                    gcs_port=args.port)
         info = {
             "gcs_address": node.gcs_address,
             "session_dir": node.session_dir,
@@ -61,8 +64,11 @@ def cmd_start(args):
 
             port_file = os.path.join(node.session_dir, "dashboard_port")
             env = dict(os.environ)
-            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            from ray_tpu._private import repo_root
+
+            env["PYTHONPATH"] = (
+                repo_root() + os.pathsep + env.get("PYTHONPATH", "")
+            )
             dash_out = open(
                 os.path.join(node.session_dir, "logs", "dashboard.out"), "ab"
             )
@@ -99,7 +105,20 @@ def cmd_start(args):
         node._gcs_monitor = None
     else:
         addr = _resolve_address(args)
-        node = Node(head=False, gcs_address=addr, resources=resources)
+        node = Node(head=False, gcs_address=addr, resources=resources,
+                    host=args.host)
+        if os.environ.get("RTPU_STATE_FILE"):
+            # Only an explicit per-node state file (the launcher's fake
+            # provider sets one per logical node) is safe to write: the
+            # default shared path would clobber the head's record and leave
+            # `ray-tpu stop` unable to stop it.
+            os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
+            with open(_STATE_FILE, "w") as f:
+                json.dump({
+                    "gcs_address": addr,
+                    "session_dir": node.session_dir,
+                    "pids": [p.pid for p in node.processes.values()],
+                }, f)
         print(f"worker node started; raylet on port {node.raylet_port}")
 
 
@@ -117,6 +136,18 @@ def cmd_stop(args):
         except ProcessLookupError:
             pass
     os.remove(_STATE_FILE)
+
+
+def cmd_up(args):
+    from ray_tpu.autoscaler.launcher import up
+
+    up(args.config)
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler.launcher import down
+
+    down(args.config)
 
 
 def cmd_status(args):
@@ -253,12 +284,25 @@ def main(argv=None):
     p.add_argument("--head", action="store_true")
     p.add_argument("--address", default=None)
     p.add_argument("--resources", default=None)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (use the node's reachable IP for "
+                        "multi-host clusters)")
+    p.add_argument("--port", type=int, default=0,
+                   help="fixed GCS port for the head (0 = auto)")
     p.add_argument("--dashboard-port", type=int, default=-1,
                    help=">=0 to start the dashboard (0 = auto port)")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("stop")
     p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("up", help="provision + bootstrap a cluster from YAML")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="stop + terminate a YAML-defined cluster")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
 
     for name, fn in (("status", cmd_status), ("nodes", cmd_nodes),
                      ("actors", cmd_actors), ("memory", cmd_memory)):
