@@ -93,6 +93,8 @@ def load_cluster_config(path: str) -> dict:
     config.setdefault("head_start_command", DEFAULT_HEAD_START)
     config.setdefault("worker_start_command", DEFAULT_WORKER_START)
     config.setdefault("stop_command", DEFAULT_STOP)
+    # reject an unknowable GCS address BEFORE provisioning anything
+    _extract_port(config["head_start_command"])
     return config
 
 

@@ -7,4 +7,13 @@ over a jax device mesh (north-star config 3: CPU rollouts + TPU learner).
 
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (  # noqa: F401
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig  # noqa: F401
+from ray_tpu.rllib.env.multi_agent import (  # noqa: F401
+    MultiAgentCartPole,
+    MultiAgentEnv,
+)

@@ -65,6 +65,9 @@ class DQNEnvRunner:
         self.rng = np.random.default_rng(seed)
         self.obs, _ = self.envs.reset(seed=seed)
         self._episode_returns = np.zeros(num_envs)
+        # gymnasium NEXT_STEP autoreset: the step after a done is a
+        # fabricated transition (action ignored, reward 0) — mask it out
+        self._autoreset = np.zeros(num_envs, bool)
 
     def obs_and_action_dims(self):
         return (int(np.prod(self.envs.single_observation_space.shape)),
@@ -78,6 +81,7 @@ class DQNEnvRunner:
         act_b = np.zeros((T, N), np.int64)
         rew_b = np.zeros((T, N), np.float32)
         done_b = np.zeros((T, N), np.float32)
+        valid_b = np.ones((T, N), bool)
         completed = []
         for t in range(T):
             q = numpy_q_forward(params, self.obs)
@@ -85,8 +89,10 @@ class DQNEnvRunner:
             random = self.rng.integers(0, q.shape[-1], size=N)
             explore = self.rng.random(N) < epsilon
             actions = np.where(explore, random, greedy)
+            valid_b[t] = ~self._autoreset
             nxt, rew, term, trunc, _ = self.envs.step(actions)
             done = np.logical_or(term, trunc)
+            self._autoreset = done
             obs_b[t] = self.obs
             act_b[t] = actions
             rew_b[t] = rew
@@ -98,7 +104,8 @@ class DQNEnvRunner:
                 completed.append(float(self._episode_returns[i]))
                 self._episode_returns[i] = 0.0
             self.obs = nxt
-        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        keep = valid_b.reshape(T * N)
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])[keep]  # noqa: E731
         return {
             "obs": flat(obs_b),
             "next_obs": flat(nxt_b),

@@ -1,0 +1,464 @@
+"""SAC — Soft Actor-Critic for continuous control (reference:
+rllib/algorithms/sac/sac.py:407 + sac_learner/sac_torch_learner, new-stack
+EnvRunner/Learner shape re-designed TPU-first: CPU actors collect
+transitions with a numpy copy of the squashed-Gaussian policy; the whole
+update — twin-critic TD, reparameterized actor, auto-tuned temperature,
+polyak target sync — is ONE jit over the device mesh with the batch
+sharded on dp).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.rl_module import (
+    numpy_gaussian_forward,
+    sample_squashed_actions,
+)
+
+
+class ContinuousReplayBuffer:
+    """Uniform ring buffer of continuous-action transitions."""
+
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity, action_dim), np.float32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.size = 0
+        self._pos = 0
+
+    def add_batch(self, batch: Dict[str, np.ndarray]):
+        n = len(batch["obs"])
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = batch["obs"]
+        self.next_obs[idx] = batch["next_obs"]
+        self.actions[idx] = batch["actions"]
+        self.rewards[idx] = batch["rewards"]
+        self.dones[idx] = batch["dones"]
+        self._pos = int((self._pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=n)
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class SACEnvRunner:
+    """Transition collector for continuous action spaces (CPU actor,
+    numpy policy copy — never initializes a jax runtime)."""
+
+    def __init__(self, env_name: str, num_envs: int, seed: int = 0):
+        import gymnasium as gym
+
+        self.envs = gym.make_vec(env_name, num_envs=num_envs,
+                                 vectorization_mode="sync")
+        self.num_envs = num_envs
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.envs.reset(seed=seed)
+        space = self.envs.single_action_space
+        self.low = np.asarray(space.low, np.float32)
+        self.high = np.asarray(space.high, np.float32)
+        self._episode_returns = np.zeros(num_envs)
+        # gymnasium NEXT_STEP autoreset: mask the fabricated post-done step
+        self._autoreset = np.zeros(num_envs, bool)
+
+    def space_dims(self):
+        return (
+            int(np.prod(self.envs.single_observation_space.shape)),
+            int(np.prod(self.envs.single_action_space.shape)),
+            self.low.tolist(),
+            self.high.tolist(),
+        )
+
+    def sample(self, actor_params, rollout_len: int, *,
+               random: bool = False) -> Dict[str, np.ndarray]:
+        """rollout_len steps per env; `random=True` collects warm-up
+        transitions from the uniform policy (reference: SAC's
+        num_steps_sampled_before_learning_starts)."""
+        T, N = rollout_len, self.num_envs
+        obs_b = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        nxt_b = np.zeros_like(obs_b)
+        act_b = np.zeros((T, N) + self.low.shape, np.float32)
+        rew_b = np.zeros((T, N), np.float32)
+        done_b = np.zeros((T, N), np.float32)
+        valid_b = np.ones((T, N), bool)
+        completed = []
+        for t in range(T):
+            valid_b[t] = ~self._autoreset
+            if random:
+                actions = self.rng.uniform(
+                    self.low, self.high, size=(N,) + self.low.shape
+                ).astype(np.float32)
+            else:
+                mean, log_std = numpy_gaussian_forward(actor_params, self.obs)
+                actions = sample_squashed_actions(
+                    self.rng, mean, log_std, self.low, self.high
+                ).astype(np.float32)
+            nxt, rew, term, trunc, _ = self.envs.step(actions)
+            done = np.logical_or(term, trunc)
+            self._autoreset = done
+            obs_b[t] = self.obs
+            act_b[t] = actions
+            rew_b[t] = rew
+            # bootstrap through time-limit truncations, cut on terminations
+            done_b[t] = term.astype(np.float32)
+            nxt_b[t] = nxt
+            self._episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self.obs = nxt
+        keep = valid_b.reshape(T * N)
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])[keep]  # noqa: E731
+        return {
+            "obs": flat(obs_b),
+            "next_obs": flat(nxt_b),
+            "actions": flat(act_b),
+            "rewards": flat(rew_b),
+            "dones": flat(done_b),
+            "episode_returns": np.asarray(completed, np.float32),
+        }
+
+
+class SACLearner:
+    """Twin-critic + reparameterized actor + auto-alpha, one jit.
+
+    Actions are learned in squashed space scaled to the env bounds; the
+    tanh log-det correction keeps the entropy term exact
+    (reference: sac_torch_learner.compute_loss_for_module)."""
+
+    def __init__(self, obs_dim: int, action_dim: int, low, high, *,
+                 actor_lr: float = 3e-4, critic_lr: float = 3e-4,
+                 alpha_lr: float = 3e-4, gamma: float = 0.99,
+                 tau: float = 0.005, hidden=(256, 256), seed: int = 0,
+                 target_entropy: Optional[float] = None,
+                 mesh_devices: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu.rllib.core.rl_module import (
+            SquashedGaussianModule,
+            TwinQModule,
+        )
+
+        self.actor = SquashedGaussianModule(action_dim=action_dim,
+                                            hidden=tuple(hidden))
+        self.critic = TwinQModule(hidden=tuple(hidden))
+        self.actor_params = self.actor.init_params(obs_dim, seed)
+        self.critic_params = self.critic.init_params(obs_dim, action_dim,
+                                                     seed + 1)
+        self.target_params = jax.tree.map(lambda x: x, self.critic_params)
+        self.log_alpha = jnp.zeros(())
+        self.actor_opt = optax.adam(actor_lr)
+        self.critic_opt = optax.adam(critic_lr)
+        self.alpha_opt = optax.adam(alpha_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.critic_opt_state = self.critic_opt.init(self.critic_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self._key = jax.random.PRNGKey(seed + 2)
+        if target_entropy is None:
+            target_entropy = -float(action_dim)  # reference default
+
+        devices = (jax.devices()[:mesh_devices] if mesh_devices
+                   else jax.devices())
+        self.mesh = Mesh(np.array(devices), ("dp",))
+        self._batch_sharding = NamedSharding(self.mesh, P("dp"))
+        self._replicated = NamedSharding(self.mesh, P())
+
+        actor_mod, critic_mod = self.actor, self.critic
+        low_j = jnp.asarray(low, jnp.float32)
+        high_j = jnp.asarray(high, jnp.float32)
+        scale = (high_j - low_j) * 0.5
+        center = (high_j + low_j) * 0.5
+
+        def sample_action(params, obs, key):
+            mean, log_std = actor_mod.apply({"params": params}, obs)
+            std = jnp.exp(log_std)
+            raw = mean + std * jax.random.normal(key, mean.shape)
+            squashed = jnp.tanh(raw)
+            action = center + scale * squashed
+            # Gaussian logp minus tanh log-det minus the affine scale
+            logp = (
+                -0.5 * (((raw - mean) / std) ** 2
+                        + 2.0 * log_std + jnp.log(2.0 * jnp.pi))
+            ).sum(-1)
+            logp -= jnp.log(
+                scale * (1.0 - squashed ** 2) + 1e-6
+            ).sum(-1)
+            return action, logp
+
+        def update_fn(actor_p, critic_p, target_p, log_alpha,
+                      actor_os, critic_os, alpha_os, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critic: clipped double-Q soft target
+            next_a, next_logp = sample_action(actor_p, batch["next_obs"], k1)
+            tq1, tq2 = critic_mod.apply({"params": target_p},
+                                        batch["next_obs"], next_a)
+            target_q = batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+                jnp.minimum(tq1, tq2) - alpha * next_logp
+            )
+            target_q = jax.lax.stop_gradient(target_q)
+
+            def critic_loss_fn(p):
+                q1, q2 = critic_mod.apply({"params": p}, batch["obs"],
+                                          batch["actions"])
+                return jnp.mean((q1 - target_q) ** 2
+                                + (q2 - target_q) ** 2)
+
+            critic_loss, cgrads = jax.value_and_grad(critic_loss_fn)(critic_p)
+            cupd, critic_os = self.critic_opt.update(cgrads, critic_os,
+                                                     critic_p)
+            critic_p = optax.apply_updates(critic_p, cupd)
+
+            # --- actor: maximize soft value under the fresh critics
+            def actor_loss_fn(p):
+                a, logp = sample_action(p, batch["obs"], k2)
+                q1, q2 = critic_mod.apply({"params": critic_p},
+                                          batch["obs"], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+            (actor_loss, logp), agrads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(actor_p)
+            aupd, actor_os = self.actor_opt.update(agrads, actor_os, actor_p)
+            actor_p = optax.apply_updates(actor_p, aupd)
+
+            # --- temperature: drive entropy toward the target
+            def alpha_loss_fn(la):
+                return -jnp.mean(
+                    la * jax.lax.stop_gradient(logp + target_entropy)
+                )
+
+            alpha_loss, lgrads = jax.value_and_grad(alpha_loss_fn)(log_alpha)
+            lupd, alpha_os = self.alpha_opt.update(lgrads, alpha_os,
+                                                   log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, lupd)
+
+            # --- polyak target sync, every step (tau-weighted)
+            target_p = jax.tree.map(
+                lambda t, o: (1.0 - tau) * t + tau * o, target_p, critic_p
+            )
+            aux = {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": alpha,
+                "entropy": -jnp.mean(logp),
+            }
+            return (actor_p, critic_p, target_p, log_alpha,
+                    actor_os, critic_os, alpha_os, aux)
+
+        rep = self._replicated
+        self._update = jax.jit(
+            update_fn,
+            in_shardings=(rep,) * 7 + (self._batch_sharding, rep),
+            out_shardings=(rep,) * 7 + (None,),
+        )
+
+    def _pad_to_devices(self, batch):
+        import jax
+
+        n = len(batch["obs"])
+        pad = (-n) % self.mesh.size
+        if pad:
+            batch = {
+                k: np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+                for k, v in batch.items()
+            }
+        return jax.device_put(batch, self._batch_sharding)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        (self.actor_params, self.critic_params, self.target_params,
+         self.log_alpha, self.actor_opt_state, self.critic_opt_state,
+         self.alpha_opt_state, aux) = self._update(
+            self.actor_params, self.critic_params, self.target_params,
+            self.log_alpha, self.actor_opt_state, self.critic_opt_state,
+            self.alpha_opt_state, self._pad_to_devices(batch), sub,
+        )
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_actor_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.actor_params)
+
+
+class SACConfig:
+    def __init__(self):
+        self.env_name: Optional[str] = None
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 32
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.hidden = (256, 256)
+        self.buffer_capacity = 200_000
+        self.train_batch_size = 256
+        self.learner_steps_per_iteration = 32
+        self.learning_starts = 1_500
+        self.target_entropy: Optional[float] = None
+        self.seed = 0
+
+    def environment(self, env: str) -> "SACConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    num_envs_per_env_runner=None,
+                    rollout_fragment_length=None) -> "SACConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, actor_lr=None, critic_lr=None, alpha_lr=None,
+                 gamma=None, tau=None, model_hidden=None,
+                 buffer_capacity=None, train_batch_size=None,
+                 learner_steps_per_iteration=None, learning_starts=None,
+                 target_entropy=None) -> "SACConfig":
+        for name, val in [
+            ("actor_lr", actor_lr), ("critic_lr", critic_lr),
+            ("alpha_lr", alpha_lr), ("gamma", gamma), ("tau", tau),
+            ("hidden", model_hidden), ("buffer_capacity", buffer_capacity),
+            ("train_batch_size", train_batch_size),
+            ("learner_steps_per_iteration", learner_steps_per_iteration),
+            ("learning_starts", learning_starts),
+            ("target_entropy", target_entropy),
+        ]:
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "SACConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        assert self.env_name, "call .environment(env_name) first"
+        return SAC(self)
+
+
+class SAC:
+    """Algorithm driver (Tune-trainable shape: train() per iteration).
+
+    Off-policy loop: runners push transitions into the driver-side
+    replay buffer; `learner_steps_per_iteration` jit updates sample from
+    it (reference: sac.py training_step)."""
+
+    def __init__(self, config: SACConfig):
+        cfg = config
+        self.config = cfg
+        runner_cls = ray_tpu.remote(SACEnvRunner)
+        self.runners = [
+            runner_cls.options(num_cpus=1).remote(
+                cfg.env_name, cfg.num_envs_per_runner,
+                seed=cfg.seed + 1000 * i,
+            )
+            for i in range(cfg.num_env_runners)
+        ]
+        obs_dim, act_dim, low, high = ray_tpu.get(
+            self.runners[0].space_dims.remote(), timeout=120
+        )
+        self.learner = SACLearner(
+            obs_dim, act_dim, low, high,
+            actor_lr=cfg.actor_lr, critic_lr=cfg.critic_lr,
+            alpha_lr=cfg.alpha_lr, gamma=cfg.gamma, tau=cfg.tau,
+            hidden=cfg.hidden, seed=cfg.seed,
+            target_entropy=cfg.target_entropy,
+        )
+        self.buffer = ContinuousReplayBuffer(cfg.buffer_capacity, obs_dim,
+                                             act_dim)
+        self.rng = np.random.default_rng(cfg.seed)
+        self._weights = self.learner.get_actor_weights()
+        self._iteration = 0
+        self._timesteps = 0
+        self._recent_returns: deque = deque(maxlen=50)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        warmup = self.buffer.size < cfg.learning_starts
+        refs = [
+            r.sample.remote(self._weights, cfg.rollout_fragment_length,
+                            random=warmup)
+            for r in self.runners
+        ]
+        losses: Dict[str, float] = {}
+        for b in ray_tpu.get(refs, timeout=300):
+            self._recent_returns.extend(b.pop("episode_returns").tolist())
+            self._timesteps += len(b["obs"])
+            self.buffer.add_batch(b)
+        if self.buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.learner_steps_per_iteration):
+                mb = self.buffer.sample(self.rng, cfg.train_batch_size)
+                losses = self.learner.update(mb)
+            self._weights = self.learner.get_actor_weights()
+        return losses
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        losses = self.training_step()
+        self._iteration += 1
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else 0.0)
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "time_this_iter_s": time.perf_counter() - t0,
+            **{f"learner/{k}": v for k, v in losses.items()},
+        }
+
+    def get_weights(self):
+        return self._weights
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        import os
+        import tempfile
+
+        import cloudpickle
+
+        path = checkpoint_dir or tempfile.mkdtemp(prefix="sac_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            cloudpickle.dump({
+                "algo": "SAC",
+                "config": self.config,
+                "weights": self._weights,
+                "iteration": self._iteration,
+                "timesteps": self._timesteps,
+            }, f)
+        return path
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

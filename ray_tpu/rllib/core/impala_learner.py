@@ -93,15 +93,18 @@ class ImpalaLearner:
             vs, pg_adv = vtrace(
                 rho, batch["rewards"], discounts, values, boot_v, c
             )
-            pi_loss = -jnp.mean(
-                target_logp * jax.lax.stop_gradient(pg_adv)
-            )
-            vf_loss = 0.5 * jnp.mean(
-                (values - jax.lax.stop_gradient(vs)) ** 2
-            )
-            entropy = -jnp.mean(
-                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
-            )
+            # autoreset rows (action ignored by the env) carry zero weight
+            w = batch["valid"]
+            wsum = jnp.maximum(jnp.sum(w), 1.0)
+            pi_loss = -jnp.sum(
+                w * target_logp * jax.lax.stop_gradient(pg_adv)
+            ) / wsum
+            vf_loss = 0.5 * jnp.sum(
+                w * (values - jax.lax.stop_gradient(vs)) ** 2
+            ) / wsum
+            entropy = -jnp.sum(
+                w * jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+            ) / wsum
             total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
             return total, {
                 "pi_loss": pi_loss, "vf_loss": vf_loss, "entropy": entropy,
@@ -127,6 +130,7 @@ class ImpalaLearner:
                     "behavior_logp": self._batch_sharding,
                     "rewards": self._batch_sharding,
                     "dones": self._batch_sharding,
+                    "valid": self._batch_sharding,
                     "bootstrap_obs": NamedSharding(self.mesh, P("dp")),
                 },
             ),

@@ -95,3 +95,78 @@ def sample_actions(rng: np.random.Generator, logits: np.ndarray):
     actions = (p.cumsum(axis=-1) > u).argmax(axis=-1)
     logp = np.log(p[np.arange(len(p)), actions] + 1e-12)
     return actions, logp
+
+
+class SquashedGaussianModule(nn.Module):
+    """Tanh-squashed Gaussian policy for continuous control (SAC actor;
+    reference: rllib/algorithms/sac/sac_torch_model.py's policy head —
+    re-designed as a flax module; squashing correction lives in the
+    learner's jit)."""
+
+    action_dim: int
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim, name="mean")(x)
+        log_std = nn.Dense(self.action_dim, name="log_std")(x)
+        log_std = jnp.clip(log_std, -20.0, 2.0)
+        return mean, log_std
+
+    def init_params(self, obs_dim: int, seed: int = 0):
+        return self.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, obs_dim), jnp.float32)
+        )["params"]
+
+
+class TwinQModule(nn.Module):
+    """Two independent Q(s, a) critics (SAC's clipped double-Q;
+    reference: sac.py twin_q=True default)."""
+
+    hidden: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        qs = []
+        for name in ("q1", "q2"):
+            h = x
+            for i, width in enumerate(self.hidden):
+                h = nn.relu(nn.Dense(width, name=f"{name}_d{i}")(h))
+            qs.append(nn.Dense(1, name=f"{name}_out")(h)[:, 0])
+        return qs[0], qs[1]
+
+    def init_params(self, obs_dim: int, action_dim: int, seed: int = 0):
+        return self.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, obs_dim), jnp.float32),
+            jnp.zeros((1, action_dim), jnp.float32),
+        )["params"]
+
+
+def numpy_gaussian_forward(params, obs: np.ndarray):
+    """Numpy mirror of SquashedGaussianModule for CPU env runners."""
+    x = obs.astype(np.float32)
+    layers = sorted((k for k in params if k.startswith("Dense_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
+    for k in layers:
+        x = np.maximum(
+            x @ np.asarray(params[k]["kernel"]) + np.asarray(params[k]["bias"]),
+            0.0,
+        )
+    mean = x @ np.asarray(params["mean"]["kernel"]) + np.asarray(
+        params["mean"]["bias"])
+    log_std = x @ np.asarray(params["log_std"]["kernel"]) + np.asarray(
+        params["log_std"]["bias"])
+    return mean, np.clip(log_std, -20.0, 2.0)
+
+
+def sample_squashed_actions(rng: np.random.Generator, mean, log_std,
+                            low, high):
+    """Sample tanh-squashed actions scaled into [low, high] (numpy)."""
+    raw = mean + np.exp(log_std) * rng.standard_normal(mean.shape)
+    squashed = np.tanh(raw)
+    return low + (squashed + 1.0) * 0.5 * (high - low)

@@ -29,6 +29,10 @@ class SingleAgentEnvRunner:
         self.obs, _ = self.envs.reset(seed=seed)
         self._episode_returns = np.zeros(num_envs)
         self._completed: List[float] = []
+        # gymnasium NEXT_STEP autoreset: the step after a done ignores the
+        # action and returns the reset obs with reward 0 — those fabricated
+        # transitions must not be trained on
+        self._autoreset = np.zeros(num_envs, bool)
 
     def obs_and_action_dims(self):
         return (int(np.prod(self.envs.single_observation_space.shape)),
@@ -46,12 +50,15 @@ class SingleAgentEnvRunner:
         rew_buf = np.zeros((T, N), np.float32)
         val_buf = np.zeros((T, N), np.float32)
         done_buf = np.zeros((T, N), np.float32)
+        valid_buf = np.ones((T, N), bool)
         self._completed = []
         for t in range(T):
             logits, v = numpy_forward(params, self.obs)
             actions, logp = sample_actions(self.rng, logits)
+            valid_buf[t] = ~self._autoreset
             nxt, rew, term, trunc, _ = self.envs.step(actions)
             done = np.logical_or(term, trunc)
+            self._autoreset = done
             obs_buf[t] = self.obs
             act_buf[t] = actions
             logp_buf[t] = logp
@@ -66,6 +73,7 @@ class SingleAgentEnvRunner:
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "rewards": rew_buf, "values": val_buf, "dones": done_buf,
+            "valid": valid_buf,
         }
 
     def sample(self, params, rollout_len: int) -> Dict[str, np.ndarray]:
@@ -87,7 +95,8 @@ class SingleAgentEnvRunner:
             lastgae = delta + self.gamma * self.lambda_ * nonterminal * lastgae
             adv[t] = lastgae
         returns = adv + val_buf
-        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        keep = roll["valid"].reshape(T * N)
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])[keep]  # noqa: E731
         return {
             "obs": flat(obs_buf),
             "actions": flat(act_buf),
@@ -110,6 +119,9 @@ class SingleAgentEnvRunner:
             "behavior_logp": roll["logp"],
             "rewards": roll["rewards"],
             "dones": roll["dones"],
+            # sequences must stay time-contiguous for v-trace, so invalid
+            # (autoreset) rows are weighted out in the learner's loss
+            "valid": roll["valid"].astype(np.float32),
             "bootstrap_obs": self.obs.astype(np.float32),
             "episode_returns": np.asarray(self._completed, np.float32),
         }

@@ -92,9 +92,8 @@ def cmd_start(args):
 
             info["dashboard_port"] = _wait_port_file(port_file, dash)
             print(f"dashboard: http://127.0.0.1:{info['dashboard_port']}")
-        os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
-        with open(_STATE_FILE, "w") as f:
-            json.dump(info, f)
+        info["role"] = "head"
+        _record_node(info, replace=True)
         print(f"head started; GCS at {node.gcs_address}")
         print(f"connect with: ray_tpu.init(address='{node.gcs_address}')")
         # The supervising Node object must stay alive for the GCS monitor;
@@ -107,19 +106,41 @@ def cmd_start(args):
         addr = _resolve_address(args)
         node = Node(head=False, gcs_address=addr, resources=resources,
                     host=args.host)
-        if os.environ.get("RTPU_STATE_FILE"):
-            # Only an explicit per-node state file (the launcher's fake
-            # provider sets one per logical node) is safe to write: the
-            # default shared path would clobber the head's record and leave
-            # `ray-tpu stop` unable to stop it.
-            os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
-            with open(_STATE_FILE, "w") as f:
-                json.dump({
-                    "gcs_address": addr,
-                    "session_dir": node.session_dir,
-                    "pids": [p.pid for p in node.processes.values()],
-                }, f)
+        # Appended (never replacing) so head+worker on one machine — or
+        # several workers — all stay stoppable by `ray-tpu stop`.
+        _record_node({
+            "role": "worker",
+            "gcs_address": addr,
+            "session_dir": node.session_dir,
+            "pids": [p.pid for p in node.processes.values()],
+        }, replace=False)
         print(f"worker node started; raylet on port {node.raylet_port}")
+
+
+def _record_node(entry: dict, *, replace: bool):
+    """State file holds EVERY node started on this machine:
+    {"gcs_address": ..., "nodes": [{role, session_dir, pids}, ...]} —
+    `stop` tears all of them down. A head start replaces the record (new
+    cluster); workers append."""
+    os.makedirs(os.path.dirname(_STATE_FILE), exist_ok=True)
+    state = {"nodes": []}
+    if not replace and os.path.exists(_STATE_FILE):
+        try:
+            with open(_STATE_FILE) as f:
+                state = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            state = {"nodes": []}
+        if "nodes" not in state:  # legacy single-entry format
+            state = {"gcs_address": state.get("gcs_address", ""),
+                     "nodes": [state]}
+    state.setdefault("nodes", [])
+    state["nodes"].append(entry)
+    if entry.get("gcs_address"):
+        state["gcs_address"] = entry["gcs_address"]
+    if "dashboard_port" in entry:
+        state["dashboard_port"] = entry["dashboard_port"]
+    with open(_STATE_FILE, "w") as f:
+        json.dump(state, f)
 
 
 def cmd_stop(args):
@@ -128,13 +149,17 @@ def cmd_stop(args):
     if not os.path.exists(_STATE_FILE):
         sys.exit("no recorded cluster (started with this CLI?)")
     with open(_STATE_FILE) as f:
-        info = json.load(f)
-    for pid in info.get("pids", []):
-        try:
-            os.kill(pid, signal.SIGTERM)
-            print(f"stopped pid {pid}")
-        except ProcessLookupError:
-            pass
+        state = json.load(f)
+    nodes = state.get("nodes")
+    if nodes is None:  # legacy single-entry format
+        nodes = [state]
+    for entry in nodes:
+        for pid in entry.get("pids", []):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                print(f"stopped pid {pid}")
+            except ProcessLookupError:
+                pass
     os.remove(_STATE_FILE)
 
 

@@ -1,0 +1,144 @@
+"""SAC + multi-agent learning tests (reference: rllib learning tests —
+threshold-based; SAC is the off-policy/continuous-control pillar,
+sac.py:407; the multi-agent runner is multi_agent_env_runner.py:55)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rl_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_numpy_gaussian_matches_flax():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.core.rl_module import (
+        SquashedGaussianModule,
+        numpy_gaussian_forward,
+    )
+
+    mod = SquashedGaussianModule(action_dim=2, hidden=(16, 16))
+    params = mod.init_params(obs_dim=3, seed=0)
+    obs = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    mean_j, logstd_j = mod.apply({"params": params}, jnp.asarray(obs))
+    mean_n, logstd_n = numpy_gaussian_forward(
+        jax.tree.map(np.asarray, params), obs
+    )
+    np.testing.assert_allclose(mean_n, np.asarray(mean_j), atol=1e-5)
+    np.testing.assert_allclose(logstd_n, np.asarray(logstd_j), atol=1e-5)
+
+
+def test_sac_update_shapes():
+    from ray_tpu.rllib.algorithms.sac import SACLearner
+
+    learner = SACLearner(3, 1, [-2.0], [2.0], hidden=(32, 32), seed=0)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": rng.normal(size=(64, 3)).astype(np.float32),
+        "next_obs": rng.normal(size=(64, 3)).astype(np.float32),
+        "actions": rng.uniform(-2, 2, size=(64, 1)).astype(np.float32),
+        "rewards": rng.normal(size=64).astype(np.float32),
+        "dones": np.zeros(64, np.float32),
+    }
+    aux = learner.update(batch)
+    for key in ("critic_loss", "actor_loss", "alpha_loss", "alpha",
+                "entropy"):
+        assert np.isfinite(aux[key]), aux
+
+
+def test_sac_learns_pendulum(rl_cluster):
+    """SAC reaches clearly-better-than-random on Pendulum-v1 (random policy
+    averages about -1200; the threshold proves the twin-critic +
+    temperature machinery optimizes)."""
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                     rollout_fragment_length=16)
+        .training(model_hidden=(64, 64), learning_starts=1_000,
+                  train_batch_size=128, learner_steps_per_iteration=64)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        best = -1e9
+        for _ in range(350):
+            result = algo.train()
+            # only trust the mean once enough episodes fill the window —
+            # a near-empty deque of lucky random episodes can spike early
+            if result["num_env_steps_sampled_lifetime"] >= 12_000:
+                best = max(best, result["episode_return_mean"])
+                if best > -450:
+                    break
+        assert best > -450, f"SAC failed to learn Pendulum: best {best}"
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_env_runner_batches(rl_cluster):
+    from ray_tpu.rllib.core.rl_module import ActorCriticModule
+    from ray_tpu.rllib.env.multi_agent import (
+        MultiAgentCartPole,
+        MultiAgentEnvRunner,
+    )
+
+    runner = MultiAgentEnvRunner(
+        lambda: MultiAgentCartPole(num_agents=2),
+        lambda aid: aid,  # one policy per agent
+        gamma=0.99, lambda_=0.95, seed=0,
+    )
+    spaces = runner.spaces()
+    assert set(spaces) == {"agent_0", "agent_1"}
+    assert spaces["agent_0"] == (4, 2)
+    params = {
+        pid: ActorCriticModule(num_actions=2, hidden=(16,)).init_params(4)
+        for pid in spaces
+    }
+    batches = runner.sample(params, rollout_len=100)
+    for pid, batch in batches.items():
+        n = len(batch["obs"])
+        assert n > 0
+        for key in ("actions", "logp_old", "advantages", "returns"):
+            assert len(batch[key]) == n, (pid, key)
+        assert np.isfinite(batch["advantages"]).all()
+
+
+def test_multi_agent_ppo_learns(rl_cluster):
+    """2-agent MultiAgentCartPole with a policy PER AGENT: the joint
+    return (sum over both agents) must clear 2x the single-agent
+    threshold — both policies have to learn."""
+    from ray_tpu.rllib import MultiAgentPPO, MultiAgentPPOConfig
+    from ray_tpu.rllib.env.multi_agent import MultiAgentCartPole
+
+    algo = (
+        MultiAgentPPOConfig()
+        .environment(lambda: MultiAgentCartPole(num_agents=2))
+        .multi_agent(policy_mapping_fn=lambda aid: aid)
+        .env_runners(num_env_runners=2, rollout_fragment_length=256)
+        .training(lr=3e-4, num_epochs=6, minibatch_size=128,
+                  model_hidden=(64, 64))
+        .debugging(seed=0)
+        .build()
+    )
+    assert isinstance(algo, MultiAgentPPO)
+    try:
+        best = 0.0
+        for _ in range(80):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best > 110:
+                break
+        # random play totals ~40 (2 x ~20); 110 needs both agents improving
+        # (the joint return is the sum over both policies' episodes)
+        assert best > 110, f"multi-agent PPO failed to learn: best {best}"
+    finally:
+        algo.stop()
