@@ -62,6 +62,10 @@ class MemoryStore:
     def __init__(self):
         self._store: Dict[ObjectID, Any] = {}
         self._pending: Dict[ObjectID, _Pending] = {}
+        # io-loop callback fired when an object becomes available — the
+        # core worker's dependency-gated task dispatch hangs off it
+        # (reference: task_dependency_manager notifying the scheduler)
+        self.on_ready = None
 
     def put_pending(self, object_id: ObjectID):
         if object_id not in self._store and object_id not in self._pending:
@@ -72,6 +76,8 @@ class MemoryStore:
         p = self._pending.pop(object_id, None)
         if p is not None:
             p.resolve()
+        if self.on_ready is not None:
+            self.on_ready(object_id)
 
     def contains(self, object_id: ObjectID) -> bool:
         return object_id in self._store

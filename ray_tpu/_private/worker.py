@@ -254,6 +254,14 @@ class CoreWorker:
         self.functions = FunctionManager(self.gcs.kv_put, self.gcs.kv_get)
 
         self.memory_store = MemoryStore()
+        # Dependency-gated dispatch (reference: raylet task_dependency_
+        # manager): a normal task whose OWNED arg refs are still pending
+        # parks here instead of occupying a lease while blocked on its
+        # upstream — without this, pipelines deeper than the CPU count can
+        # deadlock (every lease held by a task waiting on a task that can't
+        # get a lease). oid bytes -> [specs waiting on it].
+        self._arg_waiting: Dict[bytes, List[dict]] = {}
+        self.memory_store.on_ready = self._on_object_ready
         self.refs = ReferenceCounter(self._on_ref_zero)
         self.executor = Executor(self)
         self.task_events = TaskEventBuffer(self)
@@ -481,6 +489,10 @@ class CoreWorker:
         actor_regs: list = []
         for kind, item in work:
             if kind == "normal":
+                blocker = self._unready_owned_arg(item)
+                if blocker is not None:
+                    self._arg_waiting.setdefault(blocker, []).append(item)
+                    continue
                 key = ts.scheduling_key(item)
                 state = self._leases.setdefault(key, _LeaseState())
                 state.queue.append(item)
@@ -507,6 +519,40 @@ class CoreWorker:
             asyncio.ensure_future(self._free_refs_batch(frees))
         if actor_regs:
             asyncio.ensure_future(self._register_actors_batch(actor_regs))
+
+    def _unready_owned_arg(self, spec: dict):
+        """First arg ref owned by US that is still pending, else None.
+        Borrowed refs (other owners) are not gated — the executing worker
+        awaits them as before (the owner will have applied its own gating
+        to the producing task)."""
+        for _kind, _key, wire in spec["args"]:
+            ref = wire.get("ref") if isinstance(wire, dict) else None
+            if not ref:
+                continue
+            id_bytes, owner = ref
+            if owner and tuple(owner) == self.address and \
+                    self.memory_store.is_pending(ObjectID(id_bytes)):
+                return id_bytes
+        return None
+
+    def _on_object_ready(self, oid: ObjectID):
+        """io-loop: an owned object resolved — re-dispatch tasks parked on
+        it (each re-checks its remaining args and may park again)."""
+        waiters = self._arg_waiting.pop(oid.binary(), None)
+        if not waiters:
+            return
+        states: Dict[tuple, _LeaseState] = {}
+        for spec in waiters:
+            blocker = self._unready_owned_arg(spec)
+            if blocker is not None:
+                self._arg_waiting.setdefault(blocker, []).append(spec)
+                continue
+            key = ts.scheduling_key(spec)
+            state = self._leases.setdefault(key, _LeaseState())
+            state.queue.append(spec)
+            states[key] = state
+        for key, state in states.items():
+            asyncio.ensure_future(self._pump_leases(key, state))
 
     async def _register_actors_batch(self, items):
         """One SubscribeMany + one RegisterActors round-trip for a burst of

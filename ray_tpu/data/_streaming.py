@@ -92,7 +92,12 @@ class MapOperator:
         # the max_in_flight window; optionally object-store pressure) holds
         # the next launch.
         in_flight: "collections.deque" = collections.deque()
-        task = _map_block_task.options(num_cpus=self.num_cpus)
+        # carry the logical stage name into the task spec: the timeline /
+        # task events then show "Data[MapBatches(fn)+Filter]" instead of an
+        # anonymous _map_block_task (reference: data tasks named per op)
+        task = _map_block_task.options(
+            num_cpus=self.num_cpus, name=f"Data[{self.name}]"
+        )
 
         def may_launch():
             return all(p.can_add_input(self, len(in_flight)) for p in policies)
@@ -156,6 +161,7 @@ class MapOperator:
                 p.can_add_input(self, sum(load)) for p in policies
             )
 
+        produced: List[Any] = []
         try:
             for ref in upstream:
                 while in_flight and not may_launch():
@@ -170,11 +176,30 @@ class MapOperator:
                 out = pool[idx].apply.remote(ref, self.is_batch_fn)
                 in_flight.append((out, idx))
                 load[idx] += 1
+                produced.append(out)
+                if len(produced) >= 32:
+                    # prune resolved refs: holding every output ref for the
+                    # stage's lifetime would pin the stage's entire output
+                    # in the store (the streaming window must stay bounded)
+                    _, produced = ray_tpu.wait(
+                        produced, num_returns=len(produced), timeout=0
+                    )
             while in_flight:
                 done_ref, done_idx = in_flight.popleft()
                 load[done_idx] -= 1
                 yield done_ref
         finally:
+            # The stage yields refs as soon as they're submitted; a
+            # downstream stage may not have RESOLVED them yet. Wait until
+            # every produced block is computed before killing the pool, or
+            # consumers see ActorDiedError on perfectly good refs.
+            if produced:
+                try:
+                    # only still-unresolved refs remain after pruning
+                    ray_tpu.wait(produced, num_returns=len(produced),
+                                 timeout=60)
+                except Exception:
+                    pass
             for a in pool:
                 try:
                     ray_tpu.kill(a)
@@ -215,11 +240,64 @@ class RechunkOperator:
             yield ray_tpu.put(out)
 
 
+class FusedMapOperator(MapOperator):
+    """Several adjacent task-based map stages collapsed into one task per
+    block (reference: data/_internal/logical/rules/operator_fusion.py —
+    MapFusionRule): a map->filter->map chain costs one task launch and one
+    block materialization instead of three."""
+
+    def __init__(self, ops: List[MapOperator]):
+        chain = [(op.fn, op.is_batch_fn) for op in ops]
+
+        def fused(block, _chain=chain):
+            for fn, is_batch in _chain:
+                block = _apply(fn, block, is_batch)
+            return block
+
+        super().__init__(
+            fused,
+            is_batch_fn=True,
+            num_cpus=max(op.num_cpus for op in ops),
+            max_in_flight=min(op.max_in_flight for op in ops),
+            name="+".join(op.name for op in ops),
+        )
+
+
+def fuse_operators(operators: List[Any]) -> List[Any]:
+    """Plan rewrite: merge runs of adjacent task-based MapOperators.
+    Actor-pool stages (stateful UDF construction) and Rechunk stages
+    (block-shape barriers) break a run."""
+    out: List[Any] = []
+    run: List[MapOperator] = []
+
+    def flush():
+        if len(run) > 1:
+            out.append(FusedMapOperator(run))
+        elif run:
+            out.append(run[0])
+        run.clear()
+
+    for op in operators:
+        fusable = (
+            isinstance(op, MapOperator)
+            and not isinstance(op, FusedMapOperator)
+            and not op.compute_actors
+        )
+        if fusable:
+            run.append(op)
+        else:
+            flush()
+            out.append(op)
+    flush()
+    return out
+
+
 def execute_plan(source_refs: List[Any],
                  operators: List[MapOperator]) -> Iterator[Any]:
-    """Chain the stages into one lazy pull pipeline of block refs."""
+    """Chain the stages into one lazy pull pipeline of block refs (after
+    the fusion rewrite)."""
     stream: Iterator[Any] = iter(source_refs)
-    for op in operators:
+    for op in fuse_operators(operators):
         stream = op.stream(stream)
     return stream
 

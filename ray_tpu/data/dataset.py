@@ -131,24 +131,20 @@ class Dataset:
             max(1, (self.count() + rows_per_block - 1) // rows_per_block)
         )
 
-    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        """Materializing full shuffle (block concat + permutation)."""
-        rng = np.random.default_rng(seed)
-        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
-        whole = concat_blocks(blocks)
-        n = block_num_rows(whole)
-        perm = rng.permutation(n)
-        if isinstance(whole, dict):
-            shuffled: Block = {k: np.asarray(v)[perm] for k, v in whole.items()}
-        else:
-            shuffled = [whole[i] for i in perm]
-        nblocks = max(1, len(self._source_refs))
-        per = max(1, (n + nblocks - 1) // nblocks)
-        refs = [
-            ray_tpu.put(slice_block(shuffled, i * per, min(n, (i + 1) * per)))
-            for i in range((n + per - 1) // per)
-        ]
-        return Dataset(refs)
+    def random_shuffle(self, seed: Optional[int] = None, *,
+                       num_parts: Optional[int] = None) -> "Dataset":
+        """Distributed two-stage shuffle (reference: Dataset.random_shuffle
+        via the shuffle exchange): scatter tasks split every block's rows
+        uniformly across partitions, merge tasks permute within each — the
+        driver only routes refs, never block data. `num_parts` sets the
+        output block count (default: input block count, capped) — raise it
+        for very large datasets so each merge task's partition stays
+        worker-memory-sized."""
+        from ray_tpu.data._exchange import distributed_random_shuffle
+
+        refs = list(self._iter_block_refs())
+        return Dataset(distributed_random_shuffle(refs, seed,
+                                                  num_parts=num_parts))
 
     def split(self, n: int, equal: bool = True) -> List["Dataset"]:
         """Materializing row-exact split (reference: Dataset.split).
