@@ -65,6 +65,8 @@ class ProxyActor:
 
             srv = RpcServer("127.0.0.1")
             srv.register("ServeCall", self._handle_rpc_call)
+            srv.register("ServeStreamNext", self._handle_rpc_stream_next)
+            srv.register("ServeStreamCancel", self._handle_rpc_stream_cancel)
             self._rpc_port = await srv.start(port)
             self._rpc_server = srv
             logger.info("serve rpc ingress on %d", self._rpc_port)
@@ -73,6 +75,7 @@ class ProxyActor:
     async def _handle_rpc_call(self, req):
         import cloudpickle
 
+        self._sweep_rpc_streams()
         app = req.get("app")
         info = None
         if app is not None:
@@ -85,14 +88,17 @@ class ProxyActor:
         from ray_tpu.serve._handle import DeploymentHandle
 
         method = req.get("method") or "__call__"
-        # cache per (ingress, method): a fresh handle per request would
-        # leak a long-poll thread each time and reset the p2c state
+        # cache per (ingress, method, stream): a fresh handle per request
+        # would leak a long-poll thread each time and reset the p2c state
+        stream = bool(req.get("stream"))
         if not hasattr(self, "_rpc_handles"):
             self._rpc_handles = {}
-        handle = self._rpc_handles.get((ingress, method))
+        handle = self._rpc_handles.get((ingress, method, stream))
         if handle is None:
             handle = DeploymentHandle(ingress, method_name=method)
-            self._rpc_handles[(ingress, method)] = handle
+            if stream:
+                handle = handle.options(stream=True)
+            self._rpc_handles[(ingress, method, stream)] = handle
         args = cloudpickle.loads(req["args"]) if req.get("args") else ()
         kwargs = cloudpickle.loads(req["kwargs"]) if req.get("kwargs") else {}
         # honor the client's deadline (capped): a hung replica must not
@@ -100,6 +106,36 @@ class ProxyActor:
         # after 10
         timeout = min(float(req.get("timeout") or 300.0), 300.0)
         loop = asyncio.get_running_loop()
+
+        if stream:
+            # Streaming over the multiplexed connection (reference:
+            # serve/_private/proxy.py:540 gRPCProxy streaming): the call
+            # opens a replica-side generator; the CLIENT pulls batches via
+            # ServeStreamNext at its own pace — pull-based, so a slow
+            # consumer naturally backpressures the replica (it only
+            # advances when pulled).
+            def _open():
+                return handle.remote(*args, **kwargs)
+
+            try:
+                # the dedicated stream pool: slow streams must never starve
+                # routing/non-streaming traffic out of self._pool
+                resp = await asyncio.wait_for(
+                    loop.run_in_executor(self._stream_pool, _open),
+                    timeout + 10,
+                )
+            except Exception as e:  # noqa: BLE001
+                return {"error": str(e), "app_error": True}
+            import threading as _threading
+            import time as _time
+            import uuid as _uuid
+
+            if not hasattr(self, "_rpc_streams"):
+                self._rpc_streams = {}
+            sid = _uuid.uuid4().hex
+            self._rpc_streams[sid] = {"it": resp, "ts": _time.time(),
+                                      "lock": _threading.Lock()}
+            return {"stream_id": sid}
 
         def _call():
             return handle.remote(*args, **kwargs).result(timeout=timeout)
@@ -109,6 +145,82 @@ class ProxyActor:
         except Exception as e:  # noqa: BLE001 — typed back to the client
             return {"error": str(e), "app_error": True}
         return {"result": cloudpickle.dumps(result)}
+
+    def _sweep_rpc_streams(self, idle_s: float = 600.0):
+        '''Drop streams an absent client stopped pulling (their
+        replica-side generators are cancelled).'''
+        import time as _time
+
+        now = _time.time()
+        for sid, rec in list(getattr(self, "_rpc_streams", {}).items()):
+            if now - rec["ts"] > idle_s:
+                self._rpc_streams.pop(sid, None)
+                self._close_stream_record(rec)
+
+    def _close_stream_record(self, rec):
+        """Close off the io loop: StreamingResponse.close does a remote
+        cancel round-trip and must release the handle's in-flight slot."""
+        def _close():
+            try:
+                rec["it"].close()
+            except Exception:
+                pass
+
+        try:
+            self._stream_pool.submit(_close)
+        except Exception:
+            pass
+
+    async def _handle_rpc_stream_next(self, req):
+        import cloudpickle
+
+        rec = getattr(self, "_rpc_streams", {}).get(req["stream_id"])
+        if rec is None:
+            return {"error": "unknown stream %r" % req["stream_id"],
+                    "app_error": False}
+        import time as _time
+
+        rec["ts"] = _time.time()
+        max_items = max(1, min(int(req.get("max_items") or 16), 256))
+        timeout = min(float(req.get("timeout") or 300.0), 300.0)
+        loop = asyncio.get_running_loop()
+
+        def _pull():
+            # per-stream lock: a client retry after its own timeout must
+            # not run next() concurrently with the still-blocked pull
+            # (StreamingResponse is not thread-safe)
+            if not rec["lock"].acquire(timeout=timeout):
+                raise TimeoutError("previous pull still in flight")
+            try:
+                items, done = [], False
+                try:
+                    for _ in range(max_items):
+                        items.append(next(rec["it"]))
+                except StopIteration:
+                    done = True
+                return items, done
+            finally:
+                rec["lock"].release()
+
+        try:
+            items, done = await asyncio.wait_for(
+                loop.run_in_executor(self._stream_pool, _pull), timeout + 10
+            )
+        except Exception as e:  # noqa: BLE001 — generator raised / timeout
+            self._rpc_streams.pop(req["stream_id"], None)
+            # release the p2c in-flight slot + replica-side generator
+            self._close_stream_record(rec)
+            return {"error": str(e), "app_error": True}
+        if done:
+            self._rpc_streams.pop(req["stream_id"], None)
+        return {"items": [cloudpickle.dumps(i) for i in items],
+                "done": done}
+
+    async def _handle_rpc_stream_cancel(self, req):
+        rec = getattr(self, "_rpc_streams", {}).pop(req.get("stream_id"), None)
+        if rec is not None:
+            self._close_stream_record(rec)
+        return {"ok": True}
 
     async def _route(self, path: str):
         """Longest route_prefix match. The route table refreshes on a short

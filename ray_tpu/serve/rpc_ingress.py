@@ -49,8 +49,91 @@ class RpcIngressClient:
             raise RpcIngressError(reply["error"])
         return cloudpickle.loads(reply["result"])
 
+    def call_streaming(self, app: str, *args, method: str = "__call__",
+                       timeout: float = 300.0, max_items_per_pull: int = 16,
+                       **kwargs) -> "RpcStream":
+        """Call a generator deployment; returns an iterator that pulls
+        chunks over the multiplexed connection. Pull-based: a slow consumer
+        backpressures the replica-side generator (it only advances when
+        pulled). Mirrors the reference's gRPC streaming proxy
+        (serve/_private/proxy.py:540)."""
+        req = {
+            "app": app,
+            "method": method,
+            "timeout": timeout,
+            "stream": True,
+            "args": cloudpickle.dumps(args) if args else b"",
+            "kwargs": cloudpickle.dumps(kwargs) if kwargs else b"",
+        }
+        reply = self._io.run(
+            self._client.call("ServeCall", req, timeout=timeout),
+            timeout=timeout + 10,
+        )
+        if reply.get("error"):
+            raise RpcIngressError(reply["error"])
+        return RpcStream(self, reply["stream_id"], timeout,
+                         max_items_per_pull)
+
     def close(self):
         try:
             self._io.run(self._client.close())
+        except Exception:
+            pass
+
+
+class RpcStream:
+    """Client side of a streaming ingress call."""
+
+    def __init__(self, client: RpcIngressClient, stream_id: str,
+                 timeout: float, max_items: int):
+        self._client = client
+        self._sid = stream_id
+        self._timeout = timeout
+        self._max_items = max_items
+        self._buf: list = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            reply = self._client._io.run(
+                self._client._client.call(
+                    "ServeStreamNext",
+                    {"stream_id": self._sid,
+                     "max_items": self._max_items,
+                     "timeout": self._timeout},
+                    timeout=self._timeout,
+                ),
+                timeout=self._timeout + 10,
+            )
+            if reply.get("error"):
+                self._done = True
+                raise RpcIngressError(reply["error"])
+            self._buf.extend(reply["items"])
+            self._done = reply["done"]
+        return cloudpickle.loads(self._buf.pop(0))
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def close(self):
+        """Abandon the stream (frees the proxy + replica state)."""
+        if self._done:
+            return
+        self._done = True
+        try:
+            self._client._io.run(
+                self._client._client.call(
+                    "ServeStreamCancel", {"stream_id": self._sid}, timeout=10
+                ),
+                timeout=15,
+            )
         except Exception:
             pass

@@ -158,3 +158,61 @@ def test_rpc_ingress(serve_cluster):
             client.call("nope", 1)
     finally:
         client.close()
+
+
+def test_rpc_ingress_streaming(serve_cluster):
+    """Generator deployments stream chunk-by-chunk over the multiplexed
+    binary ingress; the pull protocol backpressures a slow consumer
+    (reference: proxy.py:540 gRPC streaming)."""
+    from ray_tpu.serve.rpc_ingress import RpcIngressClient, RpcIngressError
+
+    serve = serve_cluster
+
+    @serve.deployment
+    class Gen:
+        def __init__(self):
+            self.yielded = 0
+
+        def stream(self, n):
+            for i in range(n):
+                self.yielded += 1
+                yield {"i": i}
+
+        def count(self):
+            return self.yielded
+
+        def broken(self):
+            yield "first"
+            raise RuntimeError("mid-stream-crash")
+
+    serve.run(Gen.bind(), name="genapp", route_prefix="/genapp")
+    port = serve.start_rpc_ingress()
+    client = RpcIngressClient("127.0.0.1", port)
+    try:
+        # full consumption, order preserved
+        items = list(client.call_streaming("genapp", 25, method="stream"))
+        assert [r["i"] for r in items] == list(range(25))
+
+        # slow consumer: pull granularity bounds the replica's run-ahead
+        stream = client.call_streaming("genapp", 1000, method="stream",
+                                       max_items_per_pull=4)
+        consumed = []
+        for _ in range(8):
+            consumed.append(next(stream))
+            time.sleep(0.05)
+        yielded = client.call("genapp", method="count")
+        # replica advanced only as far as the pull chain demanded (client
+        # pulls of 4 + the proxy/replica internal pull batches of 16) —
+        # nowhere near the 1000 a push model would have raced through
+        assert yielded <= 80, yielded
+        stream.close()
+
+        # mid-stream generator exception surfaces as a typed error
+        # (items in the same internal pull batch as the crash may be
+        # dropped — batch-granular, like the native streaming path)
+        stream = client.call_streaming("genapp", method="broken")
+        with pytest.raises(RpcIngressError, match="mid-stream-crash"):
+            for _ in stream:
+                pass
+    finally:
+        client.close()
