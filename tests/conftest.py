@@ -4,6 +4,7 @@ JAX-related tests run on a virtual 8-device CPU mesh: the env vars must be set
 before jax is first imported anywhere in the process.
 """
 
+import math
 import os
 import sys
 
@@ -37,18 +38,32 @@ import pytest  # noqa: E402
 _TEST_TIMEOUT_S = int(os.environ.get("RAY_TPU_TEST_TIMEOUT", "180"))
 
 
+def _item_timeout(item):
+    # @pytest.mark.timeout(N) overrides the default, mirroring pytest-timeout's
+    # marker contract (which isn't installed in this image).
+    mark = item.get_closest_marker("timeout")
+    if mark:
+        value = mark.args[0] if mark.args else mark.kwargs.get("timeout")
+        if value is not None:
+            # signal.alarm(0) would CANCEL the alarm; round fractions up.
+            return max(1, math.ceil(value))
+    return _TEST_TIMEOUT_S
+
+
 def _install_alarm(phase, item):
     import faulthandler
     import signal
 
+    deadline = _item_timeout(item)
+
     def _abort(signum, frame):
         faulthandler.dump_traceback()
         raise TimeoutError(
-            f"{item.nodeid} {phase} exceeded {_TEST_TIMEOUT_S}s timeout"
+            f"{item.nodeid} {phase} exceeded {deadline}s timeout"
         )
 
     old = signal.signal(signal.SIGALRM, _abort)
-    signal.alarm(_TEST_TIMEOUT_S)
+    signal.alarm(deadline)
     return old
 
 
