@@ -41,6 +41,9 @@ _FLAGS: Dict[str, Any] = {
     "task_push_max_batch": 16,
     # Cap on concurrent RequestWorkerLease RPCs per scheduling key.
     "max_lease_requests_in_flight": 16,
+    # Direct call channels: blocking-socket fast path for serial sync actor
+    # calls (direct_channel.py). RTPU_direct_channels=0 disables.
+    "direct_channels": True,
     # How many actor-creation lease BATCHES the GCS drives concurrently;
     # each batch pays one GCS->raylet round-trip for up to
     # actor_creation_lease_batch actors (reference: gcs_actor_scheduler.cc

@@ -94,6 +94,7 @@ class Executor:
 
     async def create_actor(self, spec: dict, actor_id: bytes) -> dict:
         loop = asyncio.get_running_loop()
+        self._loop = loop  # the pump + direct intake need it before any task
         # functions.fetch may hit the GCS KV through the blocking client — keep
         # it off the IO loop.
         cls = await loop.run_in_executor(None, self.core.functions.fetch, spec["fn_key"])
@@ -156,7 +157,6 @@ class Executor:
 
     async def _run_actor_task(self, spec: dict, fut: asyncio.Future):
         if self._serial and spec.get("type") == TASK_ACTOR:
-            self._loop = asyncio.get_running_loop()
             with self._pump_lock:
                 self._run_q.append((spec, fut))
                 start = not self._pump_running
@@ -177,27 +177,66 @@ class Executor:
 
     # ------------------------------------------------- serial-actor pump
 
+    def intake_direct(self, specs: list, reply_cb):
+        """Direct-channel intake (runs on the channel's reader thread).
+        Queues the batch for the serial pump — one enqueue+wake for the
+        whole recv batch, no io-loop hop (direct_channel.py's reason for
+        being). The pump itself always runs on the actor's single
+        _actor_pool thread: executing inline here would be one wake
+        cheaper, but a serial actor's tasks must stay on ONE thread for
+        its whole lifetime (thread-bound user state — sqlite handles,
+        threading.local caches; the reference runs all actor tasks on the
+        actor's main thread). Ordering: the channel is FIFO and, once
+        active, carries every task for this caller, so arrival order is
+        submission order."""
+        with self._pump_lock:
+            for spec in specs:
+                self._run_q.append((spec, reply_cb))
+            start = not self._pump_running
+            if start:
+                self._pump_running = True
+        if start:
+            self._actor_pool.submit(self._serial_pump)
+
     def _serial_pump(self):
-        """Consumer loop in the (single) actor thread. Executes queued
-        tasks back-to-back; each reply is queued for the io loop with at
-        most one pending wakeup (call_soon_threadsafe) at a time — replies
-        deliver immediately when the loop is idle and coalesce when it is
-        busy, and a finished task's reply is never held behind a slow
-        successor."""
+        """Consumer loop in the (single) actor thread — or, for
+        direct-channel tasks, in the channel's reader thread that claimed
+        the pump. Executes queued tasks back-to-back. Replies sink either
+        to the io loop (loop-path tasks: batched _done_q + one pending
+        wakeup) or straight onto the direct channel (callable sink) from
+        this thread."""
         while True:
             with self._pump_lock:
                 if not self._run_q:
                     self._pump_running = False
                     return
-                spec, fut = self._run_q.popleft()
+                spec, sink = self._run_q.popleft()
             reply = self._run_one_serial(spec)
-            self._done_q.append((spec, fut, reply))
+            if callable(sink):
+                if isinstance(reply, tuple) and reply[0] == "plasma":
+                    # Large return: the plasma put needs the io loop; the
+                    # channel write then happens on a pool thread — the io
+                    # loop must never block in sendall.
+                    asyncio.run_coroutine_threadsafe(
+                        self._finish_direct(spec, sink, reply[1]), self._loop)
+                else:
+                    sink(spec, reply)
+                continue
+            self._done_q.append((spec, sink, reply))
             with self._pump_lock:
                 schedule = not self._done_scheduled
                 if schedule:
                     self._done_scheduled = True
             if schedule:
                 self._loop.call_soon_threadsafe(self._drain_done)
+
+    async def _finish_direct(self, spec: dict, sink, payloads):
+        try:
+            reply = await self._finish_results(spec, payloads)
+        except Exception as e:
+            reply = self._error_reply(spec, e)
+        # sink -> pipe.send -> blocking sendall: keep it off the io loop.
+        asyncio.get_running_loop().run_in_executor(None, sink, spec, reply)
 
     def _drain_done(self):
         """On the io loop: resolve queued reply futures."""

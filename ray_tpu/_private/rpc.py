@@ -86,6 +86,17 @@ class RpcServer:
         self.port: Optional[int] = None
         self._conns: set = set()
         self._validator = None
+        self._upgrades: Dict[str, Any] = {}
+
+    def set_upgrade_hook(self, method: str, hook):
+        """Register a connection-upgrade method: ``hook(payload) ->
+        (response_payload, adopt_cb | None)``. When adopt_cb is returned the
+        socket is detached from asyncio after the response is flushed and
+        handed to ``adopt_cb(raw_blocking_socket)`` — the basis of the
+        direct call channel (direct_channel.py). The client must not send
+        anything after the upgrade request until it reads the response, or
+        those bytes would be lost in the asyncio transport buffer."""
+        self._upgrades[method] = hook
 
     def set_validator(self, fn):
         """Optional (method, payload) -> None hook run before dispatch;
@@ -131,6 +142,25 @@ class RpcServer:
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
                 mtype, seq, method, payload = msg
+                if mtype == MSG_REQUEST and method in self._upgrades:
+                    try:
+                        resp, adopt = self._upgrades[method](payload)
+                    except Exception as e:
+                        resp, adopt = {"ok": False, "reason": str(e)}, None
+                    writer.write(_pack([MSG_RESPONSE, seq, None, resp]))
+                    await writer.drain()
+                    if adopt is not None:
+                        sock = writer.get_extra_info("socket")
+                        dup = sock.dup()
+                        dup.setblocking(True)
+                        self._conns.discard(writer)
+                        writer.transport.pause_reading()
+                        # Closes the transport's fd only; the dup keeps the
+                        # TCP connection alive for the adopting thread.
+                        writer.transport.abort()
+                        adopt(dup)
+                        return
+                    continue
                 if mtype == MSG_REQUEST:
                     asyncio.ensure_future(
                         self._dispatch(writer, lock, seq, method, payload)

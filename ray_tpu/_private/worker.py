@@ -189,10 +189,12 @@ class _ActorSubmitter:
     __slots__ = (
         "actor_id", "state", "addr", "seq", "buffer", "inflight", "watched",
         "death_cause", "creation_refs", "push_queue", "pushing", "epoch",
+        "direct_pending_switch",
     )
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
+        self.direct_pending_switch = False
         self.state = "UNKNOWN"
         self.addr: Optional[Tuple[str, int]] = None
         self.seq = 0
@@ -242,6 +244,7 @@ class CoreWorker:
         self._cfg_push_batch = RTPU_CONFIG.task_push_max_batch
         self._cfg_lease_inflight = RTPU_CONFIG.max_lease_requests_in_flight
         self._cfg_actor_inflight = RTPU_CONFIG.actor_push_max_inflight
+        self._cfg_direct = RTPU_CONFIG.direct_channels
 
         self.server = RpcServer(host)
         from ray_tpu._private import schema as _schema
@@ -308,6 +311,15 @@ class CoreWorker:
         self.actor_id: Optional[bytes] = None
         self._actor_spec: Optional[dict] = None
         self.is_shutdown = False
+
+        # Direct call channels (direct_channel.py): caller-side manager +
+        # the actor-worker-side server behind a connection upgrade.
+        from ray_tpu._private import direct_channel as _dc
+
+        self._direct = _dc.DirectManager(self) if self._cfg_direct else None
+        self._direct_server = _dc.WorkerDirectServer(self)
+        self.server.set_upgrade_hook(
+            _dc.HANDSHAKE_METHOD, self._direct_upgrade)
 
         set_worker_hooks(self)
         # Connect (blocking): start server, register with raylet, attach plasma.
@@ -506,6 +518,15 @@ class CoreWorker:
                     actor_subs[actor_id] = sub
             elif kind == "free":
                 frees.append(item)
+            elif kind == "direct_switch":
+                if self._direct is not None:
+                    self._direct.on_switch_request(item)
+            elif kind == "direct_replies":
+                if self._direct is not None:
+                    self._direct.process_replies(item)
+            elif kind == "direct_down":
+                if self._direct is not None:
+                    self._direct.on_channel_down(item[0], item[1])
             else:  # notify
                 owner_addr, method, payload = item
                 asyncio.ensure_future(
@@ -623,6 +644,8 @@ class CoreWorker:
 
     def _on_ref_zero(self, oid: ObjectID):
         """Owned object's refcount hit zero: free it everywhere."""
+        if self._direct is not None:
+            self._direct.discard_object(oid.binary())
         self._post_batched("free", oid)
 
     async def _free_refs_batch(self, oids):
@@ -758,6 +781,12 @@ class CoreWorker:
     # -- get ---------------------------------------------------------------
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        if self._direct is not None and self._direct.can_serve(refs):
+            # Blocking resolve in THIS thread against the direct-channel
+            # staging store — zero io-loop round trips (direct_channel.py).
+            out = self._direct.fast_get(refs, timeout)
+            if out is not self._direct._FALLBACK:
+                return out
         deadline = None if timeout is None else time.time() + timeout
         resolutions = self.io.run(self._async_resolve_many(refs, deadline))
         out = []
@@ -1631,6 +1660,14 @@ class CoreWorker:
         if trace_ctx is not None:
             spec["trace_ctx"] = trace_ctx
         return_refs = self._register_pending(spec, refs)
+        if self._direct is not None:
+            # Fast path: once this actor's direct channel is active, the
+            # spec rides it straight from this (user) thread — the io loop
+            # never sees the task (direct_channel.py).
+            sub = self._actor_submitters.setdefault(
+                actor_id, _ActorSubmitter(actor_id))
+            if self._direct.try_submit(sub, spec):
+                return return_refs
         self._post_batched("actor", (actor_id, spec))
         return return_refs
 
@@ -1639,6 +1676,8 @@ class CoreWorker:
         pushing. Returns the submitter iff it needs a pump kick (runs on
         the io loop, called from the batched drain)."""
         sub = self._actor_submitters.setdefault(actor_id, _ActorSubmitter(actor_id))
+        if self._direct is not None and self._direct.loop_routed(sub, spec):
+            return None  # forwarded onto the active direct channel
         sub.seq += 1
         spec["seq_no"] = sub.seq
         if not sub.watched:
@@ -1736,6 +1775,8 @@ class CoreWorker:
             sub.inflight.pop(spec["task_id"], None)
             await self._process_task_reply(spec, reply)
             self._pump_actor(sub)
+            if self._direct is not None and sub.direct_pending_switch:
+                self._direct.maybe_activate(sub)
             return
         # Batched push: the receiver acks immediately and streams each
         # task's reply back as it resolves (handle_ActorTaskReplies), so a
@@ -1955,6 +1996,18 @@ class CoreWorker:
 
     # ----------------------------------------------------- executor services
 
+    def _direct_upgrade(self, payload):
+        """Connection-upgrade hook for the direct call channel handshake
+        (runs synchronously on the io loop inside RpcServer). Only serial
+        sync actors accept — everything else keeps the loop path."""
+        if not self._cfg_direct:
+            return {"ok": False, "reason": "direct channels disabled"}, None
+        if not self._direct_server.eligible():
+            return {"ok": False, "reason": "not a serial sync actor"}, None
+        caller = payload.get("caller_id", b"")
+        return {"ok": True}, (
+            lambda sock: self._direct_server.adopt(sock, caller))
+
     def on_became_actor(self, actor_id: bytes, spec: dict):
         self.actor_id = actor_id
         self._actor_spec = spec
@@ -2090,6 +2143,9 @@ class CoreWorker:
                     if batch_state["remaining"] <= 0:
                         sub.pushing -= 1
                         self._pump_actor(sub)
+                        if (self._direct is not None
+                                and sub.direct_pending_switch):
+                            self._direct.maybe_activate(sub)
 
     async def handle_GetObjectStatus(self, req):
         oid = ObjectID(req["object_id"])
@@ -2179,6 +2235,12 @@ class CoreWorker:
             return
         self.is_shutdown = True
         set_worker_hooks(None)
+        try:
+            if self._direct is not None:
+                self._direct.close_all()
+            self._direct_server.close_all()
+        except Exception:
+            pass
         try:
             self.io.run(self.server.stop(), timeout=5)
         except Exception:
