@@ -74,9 +74,9 @@ def load_cluster_config(path: str) -> dict:
             raise LauncherError(f"{path}: missing required key '{key}'")
     provider = config["provider"]
     ptype = provider.get("type")
-    if ptype not in ("static", "command", "process"):
+    if ptype not in ("static", "command", "process", "gcp-tpu"):
         raise LauncherError(
-            f"provider.type must be static|command|process, got {ptype!r}"
+            f"provider.type must be static|command|process|gcp-tpu, got {ptype!r}"
         )
     if ptype in ("static", "process") and "head_ip" not in provider:
         raise LauncherError("provider.head_ip is required")
@@ -84,6 +84,12 @@ def load_cluster_config(path: str) -> dict:
         raise LauncherError(
             "provider.create_command is required for type: command"
         )
+    if ptype == "gcp-tpu":
+        for key in ("project", "zone"):
+            if key not in provider:
+                raise LauncherError(f"provider.{key} is required for gcp-tpu")
+        if not config.get("tpu_node_types"):
+            raise LauncherError("gcp-tpu needs a tpu_node_types section")
     config.setdefault("auth", {})
     config.setdefault("file_mounts", {})
     config.setdefault("initialization_commands", [])
@@ -308,6 +314,10 @@ def _node_ips(config: dict) -> tuple:
     ptype = provider["type"]
     if ptype in ("static", "process"):
         return provider["head_ip"], list(provider.get("worker_ips", []))
+    if ptype == "gcp-tpu":
+        from ray_tpu.autoscaler.gcp_tpu import cluster_ips
+
+        return cluster_ips(_gcp_provider(config), config)
     if ptype == "command":
         # Elastic: shell templates create the fleet, then report its IPs.
         create = provider["create_command"]  # $RTPU_NODE_COUNT substituted
@@ -392,20 +402,51 @@ def down(config_path: str) -> None:
             print(f"[{ip}] stopped")
         except Exception as e:
             print(f"[{ip}] stop failed: {e}", file=sys.stderr)
-    try:
-        _runner_for(config, head_ip, 0).run(stop, timeout=60)
-        print(f"[{head_ip}] stopped")
-    except Exception as e:
-        print(f"[{head_ip}] stop failed: {e}", file=sys.stderr)
+    if head_ip:
+        try:
+            _runner_for(config, head_ip, 0).run(stop, timeout=60)
+            print(f"[{head_ip}] stopped")
+        except Exception as e:
+            print(f"[{head_ip}] stop failed: {e}", file=sys.stderr)
     terminate = config["provider"].get("terminate_command")
     if terminate:
         subprocess.run(["bash", "-c", terminate], timeout=1800)
+    if config["provider"]["type"] == "gcp-tpu":
+        from ray_tpu.autoscaler.gcp_tpu import teardown
+
+        for pid in teardown(_gcp_provider(config)):
+            print(f"terminated TPU slice {pid}")
+
+
+def _gcp_provider(config: dict):
+    from ray_tpu.autoscaler.gcp_tpu import GcpTpuNodeProvider
+
+    provider = config["provider"]
+    return GcpTpuNodeProvider(
+        project=provider["project"], zone=provider["zone"],
+        cluster_name=config["cluster_name"],
+        node_types=config.get("tpu_node_types", {}),
+        timeout_s=float(provider.get("gcloud_timeout_s", 900.0)),
+    )
 
 
 def _node_ips_cached_or_static(config: dict) -> tuple:
     provider = config["provider"]
     if provider["type"] in ("static", "process"):
         return provider["head_ip"], list(provider.get("worker_ips", []))
+    if provider["type"] == "gcp-tpu":
+        gcp = _gcp_provider(config)
+        head_type = provider.get("head_type", "head")
+        # Head slice first: down() stops workers before the head, so ips[0]
+        # must really be the head host, whatever order gcloud lists in.
+        nodes = sorted(gcp.non_terminated_nodes().items(),
+                       key=lambda kv: kv[1] != head_type)
+        ips: list = []
+        for pid, _ntype in nodes:
+            ips.extend(gcp.slice_hosts(pid))
+        if not ips:
+            return "", []
+        return ips[0], ips[1:]
     # command provider: the operator's list_command reports the live fleet
     lister = provider.get("list_command")
     if not lister:
