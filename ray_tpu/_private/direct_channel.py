@@ -419,11 +419,27 @@ class DirectManager:
     def process_replies(self, items: list):
         """io loop: authoritative bookkeeping for a batch of direct replies,
         then retire the staging entries (the memory store now serves
-        reads)."""
+        reads). The common ok-inline case runs synchronously right here —
+        no coroutine per reply batch."""
         import asyncio
 
+        slow = []
+        retire = []
+        for spec, reply in items:
+            if self.core._process_task_reply_sync(spec, reply, notify=False):
+                retire.extend(_return_oid_bytes(spec))
+            else:
+                slow.append((spec, reply))
+        if retire:
+            with self.cond:
+                for oid in retire:
+                    self.staged.pop(oid, None)
+                self.cond.notify_all()
+        if not slow:
+            return
+
         async def _run():
-            for spec, reply in items:
+            for spec, reply in slow:
                 try:
                     await self.core._process_task_reply(spec, reply)
                 finally:
@@ -544,23 +560,34 @@ class DirectManager:
         deadline = None if timeout is None else _time.monotonic() + timeout
         oids = [r.object_id() for r in refs]
         keys = [o.binary() for o in oids]
+        pending_tasks = core._pending_tasks
         with self.cond:
+            # Incremental wait: only re-check still-missing refs per wake —
+            # a 1000-ref get otherwise rescans all 1000 keys on every
+            # condition wake (O(N^2) across the batch).
+            unresolved = list(zip(oids, keys))
             while True:
-                missing = False
-                for oid, k in zip(oids, keys):
+                still = []
+                for oid, k in unresolved:
                     if k in self.staged:
                         continue
                     if k in self.pending_oids:
-                        missing = True
+                        still.append((oid, k))
                         continue
                     entry = store.get_if_exists(oid)
                     if (isinstance(entry, tuple)
                             and entry[0] in (_INLINE, _ERR)):
                         continue
+                    if entry is None and oid.task_id().binary() in pending_tasks:
+                        # Loop-path task still awaiting its reply: the loop
+                        # notifies this condition when it lands the result.
+                        still.append((oid, k))
+                        continue
                     self.stats["fast_get_fallbacks"] += 1
                     return self._FALLBACK
-                if not missing:
+                if not still:
                     break
+                unresolved = still
                 if deadline is None:
                     self.cond.wait()
                 else:
@@ -569,7 +596,7 @@ class DirectManager:
                         from ray_tpu.exceptions import GetTimeoutError
 
                         raise GetTimeoutError(
-                            f"get() timed out on direct-pending objects")
+                            "get() timed out on direct-pending objects")
             entries = []
             for oid, k in zip(oids, keys):
                 e = self.staged.get(k)
@@ -594,15 +621,27 @@ class DirectManager:
         memory store — those gets skip the io-loop round trip entirely even
         when the value arrived via the loop path."""
         store = self.core.memory_store
+        pending_tasks = self.core._pending_tasks
         for r in refs:
-            k = r.object_id().binary()
+            oid = r.object_id()
+            k = oid.binary()
             if k in self.staged or k in self.pending_oids:
                 continue
-            entry = store.get_if_exists(r.object_id())
+            entry = store.get_if_exists(oid)
             if isinstance(entry, tuple) and entry[0] in (_INLINE, _ERR):
+                continue
+            if entry is None and oid.task_id().binary() in pending_tasks:
                 continue
             return False
         return True
+
+    def notify_store(self):
+        """io loop, after landing a task reply (any path) in the memory
+        store: wake blocked fast-gets. This is what lets fast_get serve
+        LOOP-delivered results too — get() on a plain task blocks on this
+        condition instead of paying an io.run round trip per call."""
+        with self.cond:
+            self.cond.notify_all()
 
     def discard_object(self, oid_bytes: bytes):
         """io loop (ref count hit zero): drop any staged copy."""

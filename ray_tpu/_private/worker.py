@@ -251,6 +251,7 @@ class CoreWorker:
 
         self.server.set_validator(_schema.make_validator(_schema.WORKER_SCHEMAS))
         self.pool = ClientPool()
+        self.gcs_address = gcs_address
         gcs_host, gcs_port = gcs_address.rsplit(":", 1)
         self.gcs_aio = GcsAioClient(gcs_host, int(gcs_port))
         self.gcs = GcsClient(gcs_host, int(gcs_port), self.io)
@@ -1490,6 +1491,8 @@ class CoreWorker:
         self.task_events.record(spec, "FAILED", error=str(error)[:500])
         if record:
             self._release_task_arg_refs(record)
+        if self._direct is not None:
+            self._direct.notify_store()
 
     def _release_task_arg_refs(self, record):
         for ref in record.get("arg_refs", []):
@@ -1497,7 +1500,30 @@ class CoreWorker:
                 self.refs.remove_submitted_task_ref(ref.object_id())
         record["arg_refs"] = []
 
+    def _process_task_reply_sync(self, spec: dict, reply: dict,
+                                 notify: bool = True) -> bool:
+        """Synchronous fast path for the overwhelmingly common ok-inline
+        reply: no awaits, no coroutine. Returns False when the reply needs
+        the full async path (errors that may retry, plasma returns).
+        notify=False lets batch callers coalesce the fast-get wakeup."""
+        if reply.get("status") != "ok":
+            return False
+        results = reply["results"]
+        for result in results:
+            if "inline" not in result:
+                return False
+        record = self._pending_tasks.pop(spec["task_id"], None)
+        for oid, result in zip(ts.return_object_ids(spec), results):
+            self.memory_store.put(oid, (_INLINE, result["inline"], None))
+        if record:
+            self._release_task_arg_refs(record)
+        if notify and self._direct is not None:
+            self._direct.notify_store()
+        return True
+
     async def _process_task_reply(self, spec: dict, reply: dict):
+        if self._process_task_reply_sync(spec, reply):
+            return
         record = self._pending_tasks.get(spec["task_id"])
         if reply.get("status") == "error":
             if reply.get("app_error") and spec.get("retry_exceptions") and record and record["retries"] > 0:
@@ -1529,6 +1555,8 @@ class CoreWorker:
         self._pending_tasks.pop(spec["task_id"], None)
         if record:
             self._release_task_arg_refs(record)
+        if self._direct is not None:
+            self._direct.notify_store()
 
     def _store_lineage(self, spec: dict):
         """Keep specs that can recreate lost plasma returns
