@@ -44,6 +44,11 @@ _FLAGS: Dict[str, Any] = {
     # Direct call channels: blocking-socket fast path for serial sync actor
     # calls (direct_channel.py). RTPU_direct_channels=0 disables.
     "direct_channels": True,
+    # Per-node dashboard agent process (dashboard/agent.py): host stats,
+    # metrics, profiling, log serving off the raylet's loop. The test
+    # suite disables it (conftest) — one extra python process per raylet
+    # is pure boot cost on a 1-core CI box.
+    "dashboard_agent": True,
     # How many actor-creation lease BATCHES the GCS drives concurrently;
     # each batch pays one GCS->raylet round-trip for up to
     # actor_creation_lease_batch actors (reference: gcs_actor_scheduler.cc

@@ -153,6 +153,11 @@ class NodeManager:
             logger.exception("metrics endpoint failed to start")
             self.metrics_port = 0
         await self._register_node()
+        if RTPU_CONFIG.dashboard_agent:
+            try:
+                self._spawn_agent()
+            except Exception:
+                logger.exception("dashboard agent failed to start")
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._reaper_loop()))
         self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
@@ -370,8 +375,67 @@ class NodeManager:
             try:
                 self.worker_pool.reap_idle()
                 self.worker_pool.check_liveness()
+                self._check_agent()
             except Exception:
                 logger.exception("reaper error")
+
+    # ------------------------------------------------- per-node agent child
+
+    def _spawn_agent(self):
+        """Launch the per-node dashboard agent beside this raylet
+        (reference: dashboard/agent.py:25 — the raylet starts agent.py and
+        the head fans node-scoped work out to it)."""
+        import subprocess
+        import sys as _sys
+
+        log_dir = self.session_dir or "."
+        out = open(os.path.join(
+            log_dir, f"agent_{self.node_id.hex()[:12]}.log"), "ab")
+        self._agent_proc = subprocess.Popen(
+            [_sys.executable, "-m", "ray_tpu.dashboard.agent",
+             "--gcs-address", self.gcs_address,
+             "--node-id", self.node_id.hex(),
+             "--raylet-port", str(self.port),
+             "--session-dir", self.session_dir or "",
+             "--host", self.host],
+            stdout=out, stderr=subprocess.STDOUT,
+        )
+        out.close()
+
+    def _check_agent(self):
+        """Agent death detection: report to the GCS failure log (visible in
+        GetWorkerFailures / the dashboard) and restart, capped — a
+        crash-looping agent must not fork forever."""
+        proc = getattr(self, "_agent_proc", None)
+        if proc is None or proc.poll() is None:
+            return
+        rc = proc.returncode
+        self._agent_proc = None
+        asyncio.ensure_future(self.gcs.notify(
+            "ReportWorkerDeath",
+            {"worker_id": b"agent-" + self.node_id.binary(),
+             "node_id": self.node_id.binary(), "actor_id": None,
+             "reason": f"dashboard agent exited with code {rc}"},
+        ))
+        self._agent_restarts = getattr(self, "_agent_restarts", 0) + 1
+        if self._agent_restarts <= 3:
+            logger.warning(
+                "dashboard agent died (rc=%s); restart %d/3",
+                rc, self._agent_restarts)
+            self._spawn_agent()
+        else:
+            logger.error("dashboard agent died (rc=%s); restart cap hit", rc)
+            asyncio.ensure_future(self._deregister_agent())
+
+    async def _deregister_agent(self):
+        """Drop the agent's KV entry so head fan-outs stop burning connect
+        timeouts on a dead address."""
+        try:
+            await self.gcs.call(
+                "KVDel", {"ns": b"agents", "key": self.node_id.hex().encode()},
+                timeout=5)
+        except Exception:
+            pass
 
     async def _on_worker_death(self, handle):
         # release any leases held by this worker
@@ -1823,6 +1887,17 @@ class NodeManager:
     async def shutdown(self):
         for t in self._bg:
             t.cancel()
+        proc = getattr(self, "_agent_proc", None)
+        if proc is not None:
+            self._agent_proc = None
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            try:
+                await asyncio.wait_for(self._deregister_agent(), timeout=5)
+            except Exception:
+                pass
         self.worker_pool.shutdown()
         await self.server.stop()
         self.plasma.close()

@@ -90,6 +90,10 @@ class DashboardHead:
             return self._logs_api(path, query or {})
         if path.startswith("/api/profile"):
             return self._profile_api(query or {})
+        if path == "/api/node_stats":
+            return self._node_stats_api(query or {})
+        if path == "/api/agent_metrics":
+            return self._agent_metrics_api()
         if path == "/api/grafana_dashboard":
             from ray_tpu.dashboard.grafana import generate_dashboard
 
@@ -145,6 +149,22 @@ class DashboardHead:
             wid = bytes.fromhex(worker_id) if worker_id else None
         except ValueError as e:
             return 400, {"error": f"bad query value: {e}"}
+        # Prefer the node's agent (keeps sampling fan-out off the raylet
+        # loop); fall back to the raylet proxy when no agent is registered.
+        node_id = query.get("node_id")
+        if node_id:
+            rec = self._agents().get(node_id)
+            if rec is not None:
+                try:
+                    r = self._ask_agent(
+                        rec, "ProfileWorker",
+                        {"pid": pid, "worker_id": wid, "duration": duration,
+                         "hz": hz},
+                        timeout=duration + 30,
+                    )
+                    return (200, r) if "error" not in r else (404, r)
+                except Exception:
+                    pass  # agent gone mid-query: raylet path still works
         from ray_tpu._private.profiling import profile_via_raylets
 
         return profile_via_raylets(
@@ -152,6 +172,88 @@ class DashboardHead:
             pid=pid, worker_id=wid, node_filter=query.get("node_id"),
             duration=duration, hz=hz,
         )
+
+    def _agents(self) -> dict:
+        """node_id_hex -> {host, port, pid} from the GCS agent registry
+        (reference: the head discovers per-node agents and fans node-scoped
+        queries out to them, dashboard/head.py + reporter_head.py)."""
+        out = {}
+        try:
+            gcs = self._gcs_client()
+            for key in gcs.kv_keys(b"agents"):
+                raw = gcs.kv_get(b"agents", key)
+                if raw:
+                    out[key.decode()] = json.loads(raw)
+        except Exception:
+            pass
+        return out
+
+    @staticmethod
+    async def _call_agent(rec: dict, method: str, payload: dict, timeout):
+        from ray_tpu._private.rpc import RpcClient
+
+        client = RpcClient(rec["host"], rec["port"])
+        await client.connect()
+        try:
+            return await client.call(method, payload, timeout=timeout)
+        finally:
+            await client.close()
+
+    def _ask_agent(self, rec: dict, method: str, payload: dict, timeout=5.0):
+        from ray_tpu._private.rpc import IoThread
+
+        return IoThread.current().run(
+            self._call_agent(rec, method, payload, timeout),
+            timeout=timeout + 5)
+
+    def _ask_agents(self, agents: dict, method: str, timeout=5.0):
+        """Concurrent fan-out: one io-thread round, latency = slowest agent
+        (a dead agent must not serialize with the healthy ones)."""
+        from ray_tpu._private.rpc import IoThread
+
+        items = list(agents.items())
+
+        async def _gather():
+            results = await asyncio.gather(
+                *(self._call_agent(rec, method, {}, timeout)
+                  for _hexid, rec in items),
+                return_exceptions=True)
+            return results
+
+        results = IoThread.current().run(_gather(), timeout=timeout + 10)
+        ok, errors = [], {}
+        for (hexid, _rec), r in zip(items, results):
+            if isinstance(r, Exception):
+                errors[hexid] = str(r)
+            else:
+                ok.append((hexid, r))
+        return ok, errors
+
+    def _node_stats_api(self, query):
+        """GET /api/node_stats[?node_id=hex]: per-node host stats served by
+        the node's agent (fan-out when no node_id given)."""
+        agents = self._agents()
+        node_id = query.get("node_id")
+        if node_id:
+            rec = agents.get(node_id)
+            if rec is None:
+                return 404, {"error": f"no agent for node {node_id}"}
+            try:
+                return 200, self._ask_agent(rec, "NodeStats", {})
+            except Exception as e:
+                return 502, {"error": f"agent unreachable: {e}"}
+        ok, errors = self._ask_agents(agents, "NodeStats")
+        return 200, {"nodes": [r for _h, r in ok], "errors": errors,
+                     "agent_count": len(agents)}
+
+    def _agent_metrics_api(self):
+        """GET /api/agent_metrics: concatenated Prometheus text from every
+        node agent (host-level series; raylet /metrics keeps the
+        scheduler/object series)."""
+        ok, errors = self._ask_agents(self._agents(), "Metrics")
+        chunks = [r["text"] for _h, r in ok]
+        chunks += [f"# agent {h} unreachable\n" for h in errors]
+        return 200, {"text": "".join(chunks)}
 
     def _session_dir(self) -> str:
         """Cluster session dir from the GCS, cached (it never changes);

@@ -17,6 +17,9 @@ os.environ["XLA_FLAGS"] = (
 # Validate every RPC payload against the typed wire contracts
 # (_private/schema.py) in all cluster tests — contract drift fails loudly.
 os.environ.setdefault("RTPU_VALIDATE_RPC", "1")
+# One dashboard-agent process per raylet is pure boot cost on a 1-core CI
+# box; tests that exercise the agent re-enable it explicitly (test_agent.py).
+os.environ.setdefault("RTPU_dashboard_agent", "0")
 
 # A pytest plugin may have imported jax before this file ran, baking the
 # ambient JAX_PLATFORMS into its config; override it (backends are lazy, so

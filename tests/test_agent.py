@@ -1,0 +1,134 @@
+"""Per-node dashboard agent (dashboard/agent.py): spawn by the raylet,
+GCS registration, node stats / metrics / profile fan-out via the dashboard
+head, and death detection + restart + failure reporting.
+
+Reference behaviors mirrored: python/ray/dashboard/agent.py:25 (per-node
+agent process), modules/reporter/reporter_agent.py:314 (host + per-worker
+stats), the raylet<->agent fate-sharing/death-reporting contract."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def agent_cluster(monkeypatch):
+    monkeypatch.setenv("RTPU_dashboard_agent", "1")
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _gcs_client():
+    from ray_tpu._private.worker import get_global_worker
+
+    return get_global_worker().gcs
+
+
+def _wait_agents(n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        keys = _gcs_client().kv_keys(b"agents")
+        if len(keys) >= n:
+            recs = {}
+            for k in keys:
+                raw = _gcs_client().kv_get(b"agents", k)
+                if raw:
+                    recs[k.decode()] = json.loads(raw)
+            if len(recs) >= n:
+                return recs
+        time.sleep(0.3)
+    raise TimeoutError("agent never registered")
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_agent_stats_metrics_profile_and_restart(agent_cluster):
+    from ray_tpu._private.worker import get_global_worker
+    from ray_tpu.dashboard.head import start_dashboard
+
+    agents = _wait_agents(1)
+    (node_hex, rec), = agents.items()
+    assert rec["host"] and rec["port"] and rec["pid"]
+
+    # a live worker so per-worker stats and profiling have a target
+    @ray_tpu.remote
+    class Busy:
+        def spin(self, s):
+            t0 = time.time()
+            while time.time() - t0 < s:
+                sum(range(1000))
+            return b"done"
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    b = Busy.remote()
+    worker_pid = ray_tpu.get(b.pid.remote())
+
+    gcs_address = get_global_worker().gcs_address
+    _head, port = start_dashboard(gcs_address)
+    if True:
+        # --- node stats through the head's agent fan-out
+        stats = _get_json(port, "/api/node_stats")
+        assert stats["agent_count"] == 1 and not stats["errors"]
+        node = stats["nodes"][0]
+        assert node["node_id"] == node_hex
+        assert node["mem"]["total"] > 0 and node["cpu_count"] >= 1
+        assert any(w["pid"] == worker_pid for w in node["workers"])
+
+        one = _get_json(port, f"/api/node_stats?node_id={node_hex}")
+        assert one["node_id"] == node_hex
+
+        # --- prometheus text from the agent
+        metrics = _get_json(port, "/api/agent_metrics")["text"]
+        assert "ray_tpu_agent_cpu_percent" in metrics
+        assert "ray_tpu_agent_worker_rss_bytes" in metrics
+
+        # --- profile a busy worker via the agent routing
+        fut = b.spin.remote(4)
+        time.sleep(0.3)
+        prof = _get_json(
+            port,
+            f"/api/profile?pid={worker_pid}&node_id={node_hex}&duration=1")
+        folded = prof.get("folded", "") or json.dumps(prof)
+        assert "spin" in folded
+        ray_tpu.get(fut)
+
+        # --- kill the agent: death is reported and the raylet restarts it
+        import os
+        import signal
+
+        os.kill(rec["pid"], signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        reported = False
+        new_rec = None
+        while time.monotonic() < deadline:
+            failures = get_global_worker().gcs.call(
+                "GetWorkerFailures", {"limit": 200})["failures"]
+            reported = any(
+                "dashboard agent exited" in f.get("reason", "")
+                for f in failures)
+            raw = _gcs_client().kv_get(b"agents", node_hex.encode())
+            if raw:
+                cand = json.loads(raw)
+                if cand["pid"] != rec["pid"]:
+                    new_rec = cand
+            if reported and new_rec:
+                break
+            time.sleep(0.5)
+        assert reported, "agent death never reported to GCS"
+        assert new_rec, "agent was not restarted"
+        # the restarted agent serves stats again
+        stats = _get_json(port, f"/api/node_stats?node_id={node_hex}")
+        assert stats["node_id"] == node_hex
