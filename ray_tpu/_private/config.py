@@ -55,6 +55,10 @@ _FLAGS: Dict[str, Any] = {
     # leases per-actor in parallel; we batch on top).
     "actor_creation_parallelism": 8,
     "actor_creation_lease_batch": 16,
+    # Warm worker pool: after a lease, top idle workers for that job back
+    # up to this many in the background (reference: worker_pool.h:359
+    # PrestartWorkers). 0 disables.
+    "prestart_workers_min_idle": 2,
     # Actor-task pushes pipeline up to this many batch RPCs per actor
     # (reference: actor_task_submitter.h pushes without waiting for prior
     # replies; the receiver's seq_no reorder buffer restores order).

@@ -218,6 +218,8 @@ class DirectManager:
         # a dead/partitioned node otherwise costs a blocking 5s connect
         # timeout inside EVERY .remote() while the GCS still says ALIVE.
         self._connect_backoff: Dict[bytes, float] = {}
+        # actor_id -> submits seen pre-channel (channels open on the 2nd)
+        self._call_counts: Dict[bytes, int] = {}
         self.stats = {"direct_sent": 0, "fast_get_hits": 0,
                       "fast_get_fallbacks": 0, "switches": 0,
                       "channel_deaths": 0}
@@ -231,6 +233,14 @@ class DirectManager:
         actor_id = sub.actor_id
         ch = self.channels.get(actor_id)
         if ch is None:
+            # Don't pay connect+handshake+reader-thread for an actor that
+            # may only ever see one call (actor-creation storms ping each
+            # actor once — 200 channels would cost ~1s of driver CPU for
+            # nothing). The SECOND submit reveals a calling pattern.
+            calls = self._call_counts.get(actor_id, 0) + 1
+            self._call_counts[actor_id] = calls
+            if calls < 2:
+                return False
             import time as _time
 
             if (actor_id not in self.unavailable and sub.state == "ALIVE"
@@ -566,6 +576,7 @@ class DirectManager:
             # a 1000-ref get otherwise rescans all 1000 keys on every
             # condition wake (O(N^2) across the batch).
             unresolved = list(zip(oids, keys))
+            first_pass = True
             while True:
                 still = []
                 for oid, k in unresolved:
@@ -587,6 +598,15 @@ class DirectManager:
                     return self._FALLBACK
                 if not still:
                     break
+                if first_pass and len(still) > 1024:
+                    # Huge pending batch: the loop's wait_ready_many blocks
+                    # on ONE event for the whole set, while this condition
+                    # wakes per reply batch and re-scans the remainder —
+                    # O(sum of remaining) work that measurably regressed a
+                    # 50k-ref drain. Let the io.run path handle bulk gets.
+                    self.stats["fast_get_fallbacks"] += 1
+                    return self._FALLBACK
+                first_pass = False
                 unresolved = still
                 if deadline is None:
                     self.cond.wait()
@@ -634,6 +654,18 @@ class DirectManager:
                 continue
             return False
         return True
+
+    def forget_actor(self, actor_id: bytes):
+        """io loop, on terminal actor death: drop per-actor bookkeeping so
+        a driver churning short-lived actors doesn't grow these maps
+        forever. (Channel state itself is torn down by on_channel_down.)"""
+        self._call_counts.pop(actor_id, None)
+        self._connect_backoff.pop(actor_id, None)
+        self.unavailable.discard(actor_id)
+        ch = self.channels.get(actor_id)
+        if ch is not None:
+            ch.closed = True
+            ch.pipe.close()
 
     def notify_store(self):
         """io loop, after landing a task reply (any path) in the memory

@@ -397,7 +397,8 @@ class NodeManager:
              "--node-id", self.node_id.hex(),
              "--raylet-port", str(self.port),
              "--session-dir", self.session_dir or "",
-             "--host", self.host],
+             "--host", self.host,
+             "--raylet-pid", str(os.getpid())],
             stdout=out, stderr=subprocess.STDOUT,
         )
         out.close()
@@ -656,6 +657,15 @@ class NodeManager:
                 worker_env = dict(env_overrides or {})
                 if chips is not None:
                     worker_env.update(accelerators.visible_chip_env(chips))
+                prestart = RTPU_CONFIG.prestart_workers_min_idle
+                if prestart > 0 and not chips:
+                    # Top the warm pool back up in the background so the
+                    # NEXT lease pops a booted worker (reference:
+                    # worker_pool.h:359 PrestartWorkers). Chip-bound leases
+                    # are excluded — their env is per-lease.
+                    asyncio.ensure_future(self.worker_pool.prestart(
+                        job_id, worker_env or None,
+                        target_idle=prestart))
                 handle = await self.worker_pool.pop_worker(
                     job_id, worker_env or None
                 )
@@ -820,6 +830,12 @@ class NodeManager:
             if fn_blob is not None:
                 actor_payload["fn_blob_b64"] = base64.b64encode(fn_blob).decode()
             spawn_extra["actor"] = actor_payload
+        prestart = RTPU_CONFIG.prestart_workers_min_idle
+        if prestart > 0 and not chips:
+            # Warm-pool top-up: an idle hit below skips fork+boot entirely
+            # (pop_worker drives CreateActor on the reused worker).
+            asyncio.ensure_future(self.worker_pool.prestart(
+                req["job_id"], env or None, target_idle=prestart))
         handle = await self.worker_pool.pop_worker(
             req["job_id"], env or None, spawn_extra
         )
@@ -980,7 +996,128 @@ class NodeManager:
             pypath.append(await self._ensure_pip_env(pip, job_id))
         if pypath:
             env["RTPU_PYPATH_PREPEND"] = os.pathsep.join(pypath)
+        conda = runtime_env.get("conda")
+        if conda:
+            prefix = await self._ensure_conda_env(conda, job_id)
+            python = os.path.join(prefix, "bin", "python")
+            if not os.path.exists(python):
+                raise RuntimeError(
+                    f"conda env {prefix!r} has no bin/python")
+            # Workers for this env spawn via the env's own interpreter
+            # (worker_pool direct-exec path), like the reference's
+            # conda-activated worker command (runtime_env/conda.py:260).
+            env["RTPU_SPAWN_PYTHON"] = python
+            env["CONDA_PREFIX"] = prefix
+            env["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                           + os.environ.get("PATH", ""))
+        container = runtime_env.get("container")
+        if container:
+            import json as _json
+
+            env["RTPU_SPAWN_PREFIX"] = _json.dumps(
+                self._container_argv(container))
         return env
+
+    def _container_argv(self, container: dict) -> list:
+        """`docker run` prefix wrapping the worker command (reference:
+        runtime_env/image_uri.py:96 — worker-in-container). host network so
+        the worker's RPC server/ports work unchanged; /dev/shm and the
+        session dir shared so plasma and logs keep functioning. The engine
+        binary comes from RTPU_CONTAINER_EXE (tests install a fake docker
+        on PATH, like the reference's mocked container runs)."""
+        image = container.get("image")
+        if not image:
+            raise RuntimeError('runtime_env["container"] needs an "image"')
+        exe = os.environ.get("RTPU_CONTAINER_EXE", "docker")
+        argv = [exe, "run", "--rm", "--network=host",
+                "-v", "/dev/shm:/dev/shm"]
+        session = os.path.abspath(self.session_dir or ".")
+        argv += ["-v", f"{session}:{session}"]
+        for opt in container.get("run_options", []) or []:
+            argv.append(str(opt))
+        argv.append(str(image))
+        return argv
+
+    async def _ensure_conda_env(self, conda, job_id: bytes) -> str:
+        """Resolve or build a conda env; returns its prefix directory.
+
+        - str that is a directory: used as a prefix as-is.
+        - other str: named env, resolved via `conda env list --json`.
+        - dict: an environment.yml-shaped spec, built once per spec hash
+          with `conda env create -p` and cached/evicted exactly like the
+          pip target dirs (reference: runtime_env/conda.py:260
+          get_or_create_conda_env; same job-refcounted eviction).
+        """
+        import hashlib
+        import json as _json
+        import subprocess
+
+        conda_exe = os.environ.get("RTPU_CONDA_EXE", "conda")
+        if isinstance(conda, str):
+            if os.path.isdir(conda):
+                return conda
+            cache = getattr(self, "_conda_name_cache", None)
+            if cache is None:
+                cache = self._conda_name_cache = {}
+            if conda in cache:
+                return cache[conda]
+            loop = asyncio.get_running_loop()
+
+            def lookup():
+                out = subprocess.run(
+                    [conda_exe, "env", "list", "--json"],
+                    capture_output=True, text=True, timeout=60)
+                if out.returncode != 0:
+                    raise RuntimeError(
+                        f"conda env list failed: {out.stderr.strip()}")
+                for prefix in _json.loads(out.stdout).get("envs", []):
+                    if os.path.basename(prefix) == conda:
+                        return prefix
+                raise RuntimeError(f"no conda env named {conda!r}")
+
+            prefix = await loop.run_in_executor(None, lookup)
+            cache[conda] = prefix  # one conda-CLI shellout per name, ever
+            return prefix
+        spec = _json.dumps(conda, sort_keys=True)
+        h = "conda-" + hashlib.sha1(spec.encode()).hexdigest()[:16]
+        base = os.path.join(self.session_dir or ".", "runtime_envs", "venvs")
+        env_dir = os.path.join(base, h)
+        marker = os.path.join(env_dir, ".rtpu_ready")
+        if job_id:
+            self._venv_jobs.setdefault(h, set()).add(job_id)
+        lock = self._venv_locks.setdefault(h, asyncio.Lock())
+        async with lock:
+            if not os.path.exists(marker):
+                loop = asyncio.get_running_loop()
+
+                def build():
+                    import shutil
+                    import tempfile
+
+                    shutil.rmtree(env_dir, ignore_errors=True)
+                    os.makedirs(base, exist_ok=True)
+                    with tempfile.NamedTemporaryFile(
+                            "w", suffix=".yml", delete=False) as f:
+                        import yaml as _yaml
+
+                        _yaml.safe_dump(conda, f)
+                        yml = f.name
+                    try:
+                        out = subprocess.run(
+                            [conda_exe, "env", "create", "--yes",
+                             "-p", env_dir, "-f", yml],
+                            capture_output=True, text=True, timeout=1800)
+                        if out.returncode != 0:
+                            raise RuntimeError(
+                                "conda env create failed:\n"
+                                + out.stderr[-2000:])
+                    finally:
+                        os.unlink(yml)
+                    with open(marker, "w") as f:
+                        f.write("ok")
+
+                await loop.run_in_executor(None, build)
+        return env_dir
 
     async def _ensure_pip_env(self, pip: dict, job_id: bytes) -> str:
         """Per-spec-hash package dir built by `pip install --target`, shared

@@ -78,6 +78,9 @@ class WorkerPool:
         # pids whose death arrived before their "spawned" message (the fork
         # server's reaper thread can win that race for insta-crashing workers)
         self._dead_pids: Dict[int, Optional[int]] = {}
+        # direct-exec workers (conda/container): (handle, Popen) — their
+        # deaths are polled (no fork-server reaper covers them)
+        self._exec_procs: list = []
 
     # ----------------------------------------------------------- fork server
 
@@ -187,6 +190,15 @@ class WorkerPool:
     async def start_worker(
         self, job_id: bytes, env_overrides=None, spawn_extra: Optional[dict] = None
     ) -> WorkerHandle:
+        if env_overrides and ("RTPU_SPAWN_PYTHON" in env_overrides
+                              or "RTPU_SPAWN_PREFIX" in env_overrides):
+            # conda / container runtime_env: the worker must run under a
+            # DIFFERENT interpreter or inside a container, which a fork of
+            # this interpreter can never provide — exec default_worker.py
+            # directly (reference: conda.py worker command rewrite,
+            # image_uri.py worker-in-container).
+            return await self._start_worker_exec(
+                job_id, env_overrides, spawn_extra)
         await self._ensure_fork_server()
         token = self._next_token
         self._next_token += 1
@@ -209,6 +221,75 @@ class WorkerPool:
             if "actor" in spawn_extra:
                 handle.actor_ready = asyncio.Event()
         await self._fs_send({"spawn": msg})
+        return handle
+
+    async def _start_worker_exec(
+        self, job_id: bytes, env_overrides: dict,
+        spawn_extra: Optional[dict] = None,
+    ) -> WorkerHandle:
+        """Spawn a worker as a fresh subprocess of an arbitrary interpreter
+        (conda env python) and/or under a command prefix (docker run ...).
+        No actor-in-spawn fast path here: the handle's actor_ready stays
+        None, so the actor lease path drives CreateActor over RPC exactly
+        like the idle-reuse branch."""
+        import subprocess
+
+        from ray_tpu._private import repo_root
+
+        env_overrides = dict(env_overrides)
+        # env_key must cover the FULL overrides (incl. spawn keys): a conda
+        # worker must never be pooled/reused for a different env's task.
+        env_key = self._env_key(env_overrides)
+        python = env_overrides.pop("RTPU_SPAWN_PYTHON", "") or sys.executable
+        prefix = json.loads(env_overrides.pop("RTPU_SPAWN_PREFIX", "") or "[]")
+        token = self._next_token
+        self._next_token += 1
+        log_prefix = os.path.join(self._session_dir, "logs", f"worker-{token}")
+        handle = WorkerHandle(
+            worker_id=b"", pid=0, job_id=job_id,
+            startup_token=token, register_event=asyncio.Event(),
+            env_key=env_key,
+        )
+        handle.log_prefix = log_prefix
+        self._starting[token] = handle
+        cmd = prefix + [
+            python, "-m", "ray_tpu._private.workers.default_worker",
+            "--raylet-host", self._raylet_addr[0],
+            "--raylet-port", str(self._raylet_addr[1]),
+            "--gcs-address", self._gcs_addr,
+            "--node-id", self._node_id.hex(),
+            "--plasma-name", self._plasma_name,
+            "--job-id", job_id.hex(),
+            "--startup-token", str(token),
+            "--session-dir", self._session_dir,
+        ]
+        child_env = dict(os.environ)
+        child_env.update({k: str(v) for k, v in env_overrides.items()})
+        child_env["PYTHONPATH"] = (
+            repo_root() + os.pathsep + child_env.get("PYTHONPATH", ""))
+        os.makedirs(os.path.dirname(log_prefix), exist_ok=True)
+        try:
+            out = open(log_prefix + ".out", "ab")
+            err = open(log_prefix + ".err", "ab")
+            try:
+                # Own session: kill_worker kills by PROCESS GROUP (the fork
+                # server's killpg) — without setsid this worker would share
+                # the raylet's group and a routine idle-reap would SIGKILL
+                # the whole node.
+                proc = subprocess.Popen(cmd, env=child_env, stdout=out,
+                                        stderr=err, stdin=subprocess.DEVNULL,
+                                        start_new_session=True)
+            finally:
+                out.close()
+                err.close()
+        except Exception:
+            # Never leak the _starting entry (it would skew prestart's
+            # accounting forever and hold the cap).
+            self._starting.pop(token, None)
+            raise
+        handle.pid = proc.pid
+        self._by_pid[proc.pid] = handle
+        self._exec_procs.append((handle, proc))
         return handle
 
     def on_worker_registered(
@@ -315,7 +396,13 @@ class WorkerPool:
 
     def check_liveness(self):
         """Fallback death detection: if the fork server died, its orphaned
-        workers can't be waitpid-ed by anyone — poll pid liveness directly."""
+        workers can't be waitpid-ed by anyone — poll pid liveness directly.
+        Direct-exec (conda/container) workers are OUR subprocesses and are
+        always polled (reaps the zombie too)."""
+        for handle, proc in list(self._exec_procs):
+            if proc.poll() is not None:
+                self._exec_procs.remove((handle, proc))
+                self._mark_dead(handle, proc.returncode)
         if self._fs_proc is not None and self._fs_proc.returncode is None:
             return
         for handle in list(self._by_pid.values()):
@@ -349,3 +436,45 @@ class WorkerPool:
 
     def num_idle(self) -> int:
         return len(self._idle)
+
+    async def prestart(self, job_id: bytes, env_overrides=None,
+                       target_idle: int = 2, cap_starting: int = 8):
+        """Keep warm registered workers ready for this job (reference:
+        worker_pool.h:359 PrestartWorkers). Called fire-and-forget after
+        lease activity: tops idle+starting up to `target_idle` so the next
+        lease pops a booted worker instead of paying fork+boot latency.
+        On a saturated single core this converts nothing (boot CPU is the
+        bound — measured: creation runs at 0% idle); on real multi-core
+        hosts the boots overlap the caller's work."""
+        env_key = self._env_key(env_overrides)
+        have = sum(
+            1 for h in self._idle
+            if h.job_id == job_id and h.alive and h.env_key == env_key
+        )
+        # In-flight starts for this job count toward the target, or a lease
+        # burst fires N prestarts that each see have=0 and over-spawn to
+        # the global cap.
+        have += sum(
+            1 for h in self._starting.values()
+            if h.job_id == job_id and h.env_key == env_key
+        )
+        need = min(target_idle - have, cap_starting - len(self._starting))
+        if need <= 0:
+            return
+        handles = []
+        try:
+            for _ in range(need):
+                handles.append(
+                    await self.start_worker(job_id, env_overrides))
+        except Exception:
+            pass  # fork server broke; still settle what did start
+        for handle in handles:
+            try:
+                await asyncio.wait_for(
+                    handle.register_event.wait(),
+                    RTPU_CONFIG.worker_startup_timeout_s)
+            except asyncio.TimeoutError:
+                await self.kill_worker(handle)
+                continue
+            if handle.registered and not handle.leased:
+                self.push_idle(handle)

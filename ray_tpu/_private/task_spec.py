@@ -129,7 +129,9 @@ def scheduling_key(spec: dict) -> tuple:
     return (spec["fn_key"], res, strat, runtime_env_key(spec.get("runtime_env")))
 
 
-RUNTIME_ENV_SUPPORTED = ("env_vars", "working_dir", "pip", "py_modules")
+RUNTIME_ENV_SUPPORTED = (
+    "env_vars", "working_dir", "pip", "py_modules", "conda", "container",
+)
 
 
 def normalize_pip(pip) -> Optional[dict]:

@@ -1882,6 +1882,8 @@ class CoreWorker:
             sub.death_cause = rec.get("death_cause", "")
             sub.epoch += 1
             sub.pushing = 0
+            if self._direct is not None:
+                self._direct.forget_actor(sub.actor_id)
             err = ActorDiedError(sub.actor_id, f"actor died: {sub.death_cause}")
             while sub.buffer:
                 self._fail_task(sub.buffer.popleft(), err)

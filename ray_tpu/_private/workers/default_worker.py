@@ -28,6 +28,29 @@ def main(argv=None):
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
+    # runtime_env adoption, mirroring the fork-server child
+    # (workers/fork_server.py _child_main): working_dir + pypath prepends
+    # arrive via env vars because this entrypoint also runs under foreign
+    # interpreters (conda envs) and inside containers.
+    import os
+
+    wd = os.environ.get("RTPU_WORKING_DIR")
+    if wd:
+        try:
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+        except OSError:
+            print(f"runtime_env: cannot enter working_dir {wd!r}",
+                  file=sys.stderr)
+    pypath = os.environ.get("RTPU_PYPATH_PREPEND")
+    if pypath:
+        import importlib
+
+        for p in reversed(pypath.split(os.pathsep)):
+            if p and p not in sys.path:
+                sys.path.insert(0, p)
+        importlib.invalidate_caches()
+
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.worker import MODE_WORKER, CoreWorker, set_global_worker
 
@@ -39,6 +62,8 @@ def main(argv=None):
         startup_token=args.startup_token,
         session_dir=args.session_dir,
         host=args.raylet_host,
+        node_id_hex=args.node_id,
+        plasma_name=args.plasma_name,
     )
     set_global_worker(worker)
     threading.Event().wait()

@@ -44,6 +44,7 @@ def _reaper(out_lock):
 
 
 def _child_main(args, spawn):
+    _mark("start")
     os.setsid()
     for k, v in (spawn.get("env") or {}).items():
         os.environ[k] = str(v)
@@ -130,6 +131,7 @@ def _child_main(args, spawn):
             except Exception as e:
                 return {"ok": False, "error": repr(e)}
 
+    _mark("pre_core")
     worker = CoreWorker(
         mode=MODE_WORKER,
         gcs_address=args.gcs_address,
@@ -143,6 +145,7 @@ def _child_main(args, spawn):
         plasma_name=spawn.get("plasma_name", ""),
         pre_register=pre_register,
     )
+    _mark("core_done")
     set_global_worker(worker)
     secs = os.environ.get("RTPU_PROFILE_WORKER_SECS")
     if secs and os.environ.get("RTPU_PROFILE_WORKER_BOOT"):
@@ -170,7 +173,26 @@ def _child_main(args, spawn):
             prof.dump_stats(os.path.join(profile_dir, f"boot-{os.getpid()}.prof"))
         except Exception:
             pass  # diagnostics must never kill the worker
+    if os.environ.get("RTPU_BOOT_CPU_LOG"):
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        marks = " ".join(f"{k}={v * 1000:.1f}" for k, v in _BOOT_MARKS)
+        print(f"BOOT_CPU pid={os.getpid()} "
+              f"user={ru.ru_utime * 1000:.1f}ms sys={ru.ru_stime * 1000:.1f}ms "
+              f"minflt={ru.ru_minflt} marks[{marks}]",
+              file=sys.stderr, flush=True)
     threading.Event().wait()
+
+
+_BOOT_MARKS: list = []
+
+
+def _mark(label: str):
+    if os.environ.get("RTPU_BOOT_CPU_LOG"):
+        import time as _time
+
+        _BOOT_MARKS.append((label, _time.process_time()))
 
 
 def main(argv=None):
@@ -181,15 +203,23 @@ def main(argv=None):
     parser.add_argument("--session-dir", default="")
     args = parser.parse_args(argv)
 
-    # Pay the import bill once, before any fork.
+    # Pay the import bill once, before any fork. This matters double on
+    # hosts with PYTHONDONTWRITEBYTECODE=1 (this image): a module imported
+    # lazily in the CHILD recompiles from source in EVERY child — ~80 ms a
+    # pop — because nothing ever writes a .pyc. Everything a worker touches
+    # during boot or its first task must be in sys.modules before fork.
     import base64  # noqa: F401
+    import concurrent.futures  # noqa: F401
 
     import msgpack  # noqa: F401
     import numpy  # noqa: F401
 
+    import ray_tpu._private.direct_channel  # noqa: F401
     import ray_tpu._private.executor  # noqa: F401
+    import ray_tpu._private.profiling  # noqa: F401
     import ray_tpu._private.schema  # noqa: F401
     import ray_tpu._private.worker  # noqa: F401
+    import ray_tpu.util.tracing  # noqa: F401
 
     # dlopen the plasma client library once pre-fork — children inherit the
     # mapping (the module memoizes in a global), saving ~1 ms per spawn.

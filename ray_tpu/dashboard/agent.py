@@ -218,6 +218,7 @@ def main(argv=None):
     parser.add_argument("--raylet-port", type=int, required=True)
     parser.add_argument("--session-dir", default="")
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--raylet-pid", type=int, default=0)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -225,8 +226,23 @@ def main(argv=None):
         agent = DashboardAgent(args.gcs_address, args.node_id,
                                args.raylet_port, args.session_dir, args.host)
         await agent.start(0)
+        # Fate-share with the spawning raylet: when it dies (even SIGKILL,
+        # where its async shutdown never runs) this agent must exit instead
+        # of lingering as an orphan whose GCS client burns CPU reconnect-
+        # looping (reference: the agent<->raylet fate-sharing contract in
+        # dashboard/agent.py). The raylet's pid comes via argv — a ppid
+        # snapshot would race (raylet killed before we sample -> we'd
+        # capture init's pid and never notice).
+        raylet_pid = args.raylet_pid or os.getppid()
         while True:
-            await asyncio.sleep(3600)
+            await asyncio.sleep(2.0)
+            try:
+                os.kill(raylet_pid, 0)
+            except ProcessLookupError:
+                logger.info("raylet (pid %s) gone; agent exiting", raylet_pid)
+                return
+            except PermissionError:
+                pass  # alive, different uid
 
     asyncio.run(run())
 

@@ -78,57 +78,56 @@ def test_agent_stats_metrics_profile_and_restart(agent_cluster):
 
     gcs_address = get_global_worker().gcs_address
     _head, port = start_dashboard(gcs_address)
-    if True:
-        # --- node stats through the head's agent fan-out
-        stats = _get_json(port, "/api/node_stats")
-        assert stats["agent_count"] == 1 and not stats["errors"]
-        node = stats["nodes"][0]
-        assert node["node_id"] == node_hex
-        assert node["mem"]["total"] > 0 and node["cpu_count"] >= 1
-        assert any(w["pid"] == worker_pid for w in node["workers"])
+    # --- node stats through the head's agent fan-out
+    stats = _get_json(port, "/api/node_stats")
+    assert stats["agent_count"] == 1 and not stats["errors"]
+    node = stats["nodes"][0]
+    assert node["node_id"] == node_hex
+    assert node["mem"]["total"] > 0 and node["cpu_count"] >= 1
+    assert any(w["pid"] == worker_pid for w in node["workers"])
 
-        one = _get_json(port, f"/api/node_stats?node_id={node_hex}")
-        assert one["node_id"] == node_hex
+    one = _get_json(port, f"/api/node_stats?node_id={node_hex}")
+    assert one["node_id"] == node_hex
 
-        # --- prometheus text from the agent
-        metrics = _get_json(port, "/api/agent_metrics")["text"]
-        assert "ray_tpu_agent_cpu_percent" in metrics
-        assert "ray_tpu_agent_worker_rss_bytes" in metrics
+    # --- prometheus text from the agent
+    metrics = _get_json(port, "/api/agent_metrics")["text"]
+    assert "ray_tpu_agent_cpu_percent" in metrics
+    assert "ray_tpu_agent_worker_rss_bytes" in metrics
 
-        # --- profile a busy worker via the agent routing
-        fut = b.spin.remote(4)
-        time.sleep(0.3)
-        prof = _get_json(
-            port,
-            f"/api/profile?pid={worker_pid}&node_id={node_hex}&duration=1")
-        folded = prof.get("folded", "") or json.dumps(prof)
-        assert "spin" in folded
-        ray_tpu.get(fut)
+    # --- profile a busy worker via the agent routing
+    fut = b.spin.remote(4)
+    time.sleep(0.3)
+    prof = _get_json(
+        port,
+        f"/api/profile?pid={worker_pid}&node_id={node_hex}&duration=1")
+    folded = prof.get("folded", "") or json.dumps(prof)
+    assert "spin" in folded
+    ray_tpu.get(fut)
 
-        # --- kill the agent: death is reported and the raylet restarts it
-        import os
-        import signal
+    # --- kill the agent: death is reported and the raylet restarts it
+    import os
+    import signal
 
-        os.kill(rec["pid"], signal.SIGKILL)
-        deadline = time.monotonic() + 30
-        reported = False
-        new_rec = None
-        while time.monotonic() < deadline:
-            failures = get_global_worker().gcs.call(
-                "GetWorkerFailures", {"limit": 200})["failures"]
-            reported = any(
-                "dashboard agent exited" in f.get("reason", "")
-                for f in failures)
-            raw = _gcs_client().kv_get(b"agents", node_hex.encode())
-            if raw:
-                cand = json.loads(raw)
-                if cand["pid"] != rec["pid"]:
-                    new_rec = cand
-            if reported and new_rec:
-                break
-            time.sleep(0.5)
-        assert reported, "agent death never reported to GCS"
-        assert new_rec, "agent was not restarted"
-        # the restarted agent serves stats again
-        stats = _get_json(port, f"/api/node_stats?node_id={node_hex}")
-        assert stats["node_id"] == node_hex
+    os.kill(rec["pid"], signal.SIGKILL)
+    deadline = time.monotonic() + 30
+    reported = False
+    new_rec = None
+    while time.monotonic() < deadline:
+        failures = get_global_worker().gcs.call(
+            "GetWorkerFailures", {"limit": 200})["failures"]
+        reported = any(
+            "dashboard agent exited" in f.get("reason", "")
+            for f in failures)
+        raw = _gcs_client().kv_get(b"agents", node_hex.encode())
+        if raw:
+            cand = json.loads(raw)
+            if cand["pid"] != rec["pid"]:
+                new_rec = cand
+        if reported and new_rec:
+            break
+        time.sleep(0.5)
+    assert reported, "agent death never reported to GCS"
+    assert new_rec, "agent was not restarted"
+    # the restarted agent serves stats again
+    stats = _get_json(port, f"/api/node_stats?node_id={node_hex}")
+    assert stats["node_id"] == node_hex
