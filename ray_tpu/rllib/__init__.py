@@ -5,6 +5,8 @@ LearnerGroup, PPO. The torch-DDP learner is re-designed as a pjit'd update
 over a jax device mesh (north-star config 3: CPU rollouts + TPU learner).
 """
 
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig  # noqa: F401
+from ray_tpu.rllib.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig  # noqa: F401
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (  # noqa: F401

@@ -74,18 +74,32 @@ class IMPALAConfig:
             self.seed = seed
         return self
 
+    # Seam for IMPALA-engined variants (APPO): which learner class hosts
+    # the update, and what extra kwargs it takes.
+    def _learner_path(self) -> str:
+        return "ray_tpu.rllib.core.impala_learner:ImpalaLearner"
+
+    def _extra_learner_kwargs(self) -> dict:
+        return {}
+
     def build(self) -> "IMPALA":
         assert self.env_name, "call .environment(env_name) first"
         return IMPALA(self)
 
 
+def _load_learner_cls(path: str):
+    import importlib
+
+    mod, name = path.split(":")
+    return getattr(importlib.import_module(mod), name)
+
+
 class _LearnerActor:
-    """Remote host for the ImpalaLearner (reference: learner_group.py:83)."""
+    """Remote host for the learner (reference: learner_group.py:83)."""
 
-    def __init__(self, obs_dim, num_actions, cfg):
-        from ray_tpu.rllib.core.impala_learner import ImpalaLearner
-
-        self.learner = ImpalaLearner(obs_dim, num_actions, **cfg)
+    def __init__(self, obs_dim, num_actions, cfg, learner_path):
+        cls = _load_learner_cls(learner_path)
+        self.learner = cls(obs_dim, num_actions, **cfg)
 
     def update(self, batch):
         return self.learner.update_from_trajectories(batch)
@@ -116,17 +130,18 @@ class IMPALA:
             lr=config.lr, gamma=config.gamma, vf_coeff=config.vf_coeff,
             entropy_coeff=config.entropy_coeff, rho_bar=config.rho_bar,
             c_bar=config.c_bar, hidden=config.hidden, seed=config.seed,
+            **config._extra_learner_kwargs(),
         )
+        learner_path = config._learner_path()
         if config.remote_learner:
             cls = ray_tpu.remote(_LearnerActor)
             self.learner = cls.options(num_cpus=1).remote(
-                obs_dim, num_actions, learner_cfg
+                obs_dim, num_actions, learner_cfg, learner_path
             )
             self._remote = True
         else:
-            from ray_tpu.rllib.core.impala_learner import ImpalaLearner
-
-            self.learner = ImpalaLearner(obs_dim, num_actions, **learner_cfg)
+            self.learner = _load_learner_cls(learner_path)(
+                obs_dim, num_actions, **learner_cfg)
             self._remote = False
         self._weights = self._learner_call("get_weights")
         self._iteration = 0

@@ -1,0 +1,175 @@
+"""APPO learner: asynchronous PPO — IMPALA's decoupled engine with a
+PPO-clipped surrogate computed against a periodically-synced target policy.
+
+Reference: rllib/algorithms/appo/appo.py:277 + appo_torch_policy.py — APPO
+runs IMPALA's async rollout plan, but the loss replaces the plain v-trace
+policy gradient with the clipped surrogate: v-trace targets/advantages are
+computed under the TARGET ("old") policy, the surrogate ratio is the
+current/behavior ratio clamped through the old-policy importance ratio, and
+an optional KL(old || current) regularizer bounds the policy lag. The
+target network refreshes every `target_update_freq` updates
+(reference: appo.py target_network_update_freq).
+
+Design is jax-first like ImpalaLearner: the entire update is ONE jit over
+the device mesh, batch sharded on the env axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.impala_learner import shard_time_major, vtrace
+
+
+class AppoLearner:
+    def __init__(self, obs_dim: int, num_actions: int, *,
+                 lr: float = 5e-4, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 clip_param: float = 0.2,
+                 use_kl_loss: bool = False, kl_coeff: float = 1.0,
+                 target_update_freq: int = 8,
+                 hidden=(64, 64), seed: int = 0,
+                 mesh_devices: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ray_tpu.rllib.core.rl_module import ActorCriticModule
+
+        self.module = ActorCriticModule(num_actions=num_actions,
+                                        hidden=tuple(hidden))
+        self.params = self.module.init_params(obs_dim, seed)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+        self.target_update_freq = max(1, int(target_update_freq))
+        self._updates = 0
+
+        devices = jax.devices()[:mesh_devices] if mesh_devices else jax.devices()
+        self.mesh = Mesh(np.array(devices), ("dp",))
+        self._batch_sharding = NamedSharding(self.mesh, P(None, "dp"))
+        self._replicated = NamedSharding(self.mesh, P())
+        module = self.module
+
+        def logp_and_values(params, batch):
+            T, N = batch["actions"].shape
+            flat = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+            logits, v = module.apply({"params": params}, flat)
+            logp_all = jax.nn.log_softmax(logits.reshape(T, N, -1))
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            return logp_all, target_logp, v.reshape(T, N)
+
+        def loss_fn(params, target_params, batch):
+            logp_all, cur_logp, cur_values = logp_and_values(params, batch)
+            # Value estimates and the v-trace correction come from the
+            # TARGET network (reference: appo_torch_policy target_model
+            # value_function + old_policy_behaviour_logits).
+            old_logp_all, old_logp, old_values = logp_and_values(
+                target_params, batch)
+            old_logp_all = jax.lax.stop_gradient(old_logp_all)
+            old_logp = jax.lax.stop_gradient(old_logp)
+            old_values = jax.lax.stop_gradient(old_values)
+            _, boot_v = module.apply({"params": target_params},
+                                     batch["bootstrap_obs"])
+            boot_v = jax.lax.stop_gradient(boot_v)
+
+            old_ratio = jnp.exp(old_logp - batch["behavior_logp"])
+            rho = jnp.minimum(old_ratio, rho_bar)
+            c = jnp.minimum(old_ratio, c_bar)
+            discounts = gamma * (1.0 - batch["dones"])
+            vs, pg_adv = vtrace(
+                rho, batch["rewards"], discounts, old_values, boot_v, c)
+            pg_adv = jax.lax.stop_gradient(pg_adv)
+
+            # Clipped surrogate: current/behavior ratio routed through the
+            # old-policy importance ratio (reference: appo_torch_policy
+            # is_ratio clamp [0, 2] * exp(curr - prev)).
+            is_ratio = jnp.clip(
+                jnp.exp(batch["behavior_logp"] - old_logp), 0.0, 2.0)
+            logp_ratio = is_ratio * jnp.exp(cur_logp - batch["behavior_logp"])
+            surr1 = pg_adv * logp_ratio
+            surr2 = pg_adv * jnp.clip(
+                logp_ratio, 1.0 - clip_param, 1.0 + clip_param)
+            w = batch["valid"]
+            wsum = jnp.maximum(jnp.sum(w), 1.0)
+            pi_loss = -jnp.sum(w * jnp.minimum(surr1, surr2)) / wsum
+
+            # Value function trains on the v-trace targets with the CURRENT
+            # params (the target net only supplies the targets).
+            vf_loss = 0.5 * jnp.sum(
+                w * (cur_values - jax.lax.stop_gradient(vs)) ** 2) / wsum
+            entropy = -jnp.sum(
+                w * jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)) / wsum
+            kl = jnp.sum(
+                w * jnp.sum(
+                    jnp.exp(old_logp_all) * (old_logp_all - logp_all), axis=-1
+                )) / wsum
+            total = pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            if use_kl_loss:
+                total = total + kl_coeff * kl
+            return total, {
+                "pi_loss": pi_loss, "vf_loss": vf_loss, "entropy": entropy,
+                "kl": kl, "mean_rho": jnp.mean(rho),
+            }
+
+        def update_fn(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux["total_loss"] = loss
+            return params, opt_state, aux
+
+        batch_shardings = {
+            "obs": self._batch_sharding,
+            "actions": self._batch_sharding,
+            "behavior_logp": self._batch_sharding,
+            "rewards": self._batch_sharding,
+            "dones": self._batch_sharding,
+            "valid": self._batch_sharding,
+            "bootstrap_obs": NamedSharding(self.mesh, P("dp")),
+        }
+        self._update = jax.jit(
+            update_fn,
+            in_shardings=(self._replicated, self._replicated,
+                          self._replicated, batch_shardings),
+            out_shardings=(self._replicated, self._replicated, None),
+        )
+
+    def _shard(self, batch: Dict[str, np.ndarray]):
+        return shard_time_major(self.mesh, self._batch_sharding, batch)
+
+    def update_from_trajectories(
+        self, batch: Dict[str, np.ndarray]
+    ) -> Dict[str, float]:
+        import jax
+
+        batch = {k: v for k, v in batch.items() if k != "episode_returns"}
+        self.params, self.opt_state, aux = self._update(
+            self.params, self.target_params, self.opt_state,
+            self._shard(batch))
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return {k: float(v) for k, v in aux.items()}
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        import jax
+
+        self.params = jax.device_put(weights, self._replicated)
+        self.target_params = jax.device_put(weights, self._replicated)
+        self.opt_state = self.opt.init(self.params)
+        return True
+
+    def num_devices(self) -> int:
+        return self.mesh.size

@@ -43,6 +43,32 @@ def vtrace(rho, rewards, discounts, values, bootstrap_v, c):
     return vs, pg_adv
 
 
+def shard_time_major(mesh, batch_sharding, batch: Dict[str, np.ndarray]):
+    """device_put a time-major (T, N) trajectory batch with the env axis
+    padded to the mesh and sharded over dp (bootstrap_obs is (N, obs))."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    d = mesh.size
+    n = batch["actions"].shape[1]
+    pad = (-n) % d
+    if pad:
+        def pad_k(k, v):
+            if k == "bootstrap_obs":  # (N, obs): env axis is 0
+                return np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
+            return np.concatenate(  # (T, N, ...): env axis is 1
+                [v, np.repeat(v[:, -1:], pad, axis=1)], axis=1
+            )
+
+        batch = {k: pad_k(k, v) for k, v in batch.items()}
+    shardings = {
+        k: (NamedSharding(mesh, P("dp")) if k == "bootstrap_obs"
+            else batch_sharding)
+        for k in batch
+    }
+    return jax.device_put(batch, shardings)
+
+
 class ImpalaLearner:
     """Owns params/optimizer on the mesh; one jit per update, consuming
     time-major trajectory batches from (possibly stale) behavior policies."""
@@ -138,27 +164,7 @@ class ImpalaLearner:
         )
 
     def _shard(self, batch: Dict[str, np.ndarray]):
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        d = self.mesh.size
-        n = batch["actions"].shape[1]
-        pad = (-n) % d
-        if pad:
-            def pad_k(k, v):
-                if k == "bootstrap_obs":  # (N, obs): env axis is 0
-                    return np.concatenate([v, np.repeat(v[-1:], pad, axis=0)])
-                return np.concatenate(  # (T, N, ...): env axis is 1
-                    [v, np.repeat(v[:, -1:], pad, axis=1)], axis=1
-                )
-
-            batch = {k: pad_k(k, v) for k, v in batch.items()}
-        shardings = {
-            k: (NamedSharding(self.mesh, P("dp")) if k == "bootstrap_obs"
-                else self._batch_sharding)
-            for k in batch
-        }
-        return jax.device_put(batch, shardings)
+        return shard_time_major(self.mesh, self._batch_sharding, batch)
 
     def update_from_trajectories(
         self, batch: Dict[str, np.ndarray]
