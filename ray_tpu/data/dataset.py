@@ -32,6 +32,16 @@ from ray_tpu.data.block import (
 logger = logging.getLogger("ray_tpu.data")
 
 
+@ray_tpu.remote(num_cpus=0.05)
+def _block_num_rows_task(block):
+    return block_num_rows(block)
+
+
+@ray_tpu.remote(num_cpus=0.05)
+def _slice_block_task(block, start: int, end: int):
+    return slice_block(block, start, end)
+
+
 class Dataset:
     def __init__(self, source_refs: List[Any],
                  operators: Optional[List[MapOperator]] = None,
@@ -147,13 +157,17 @@ class Dataset:
                                                   num_parts=num_parts))
 
     def split(self, n: int, equal: bool = True) -> List["Dataset"]:
-        """Materializing row-exact split (reference: Dataset.split).
-        equal=True gives identical shard sizes, dropping up to n-1 trailing
-        rows (like the reference); raises if shards would be empty.
-        equal=False balances floor/ceil sizes with no rows dropped."""
-        blocks = [ray_tpu.get(r) for r in self._iter_block_refs()]
-        whole = concat_blocks(blocks)
-        total = block_num_rows(whole)
+        """Ref-level row-exact split (reference: Dataset.split, which
+        plans over block metadata and never materializes on the driver).
+        The driver sees only per-block ROW COUNTS; whole blocks move into
+        shards by reference, and only the blocks straddling a shard
+        boundary are re-sliced — by tasks, where the data lives.
+        equal=True gives identical shard sizes, dropping up to n-1
+        trailing rows (like the reference); equal=False balances
+        floor/ceil sizes with no rows dropped."""
+        refs = list(self._iter_block_refs())
+        counts = ray_tpu.get([_block_num_rows_task.remote(r) for r in refs])
+        total = sum(counts)
         if equal:
             per = total // n
             if per == 0:
@@ -165,13 +179,25 @@ class Dataset:
         else:
             base, rem = divmod(total, n)
             sizes = [base + (1 if i < rem else 0) for i in range(n)]
-        out, start = [], 0
+        shards: List[Dataset] = []
+        bi, offset = 0, 0  # cursor: current block, rows already consumed
         for size in sizes:
-            out.append(
-                Dataset([ray_tpu.put(slice_block(whole, start, start + size))])
-            )
-            start += size
-        return out
+            parts, need = [], size
+            while need > 0:
+                avail = counts[bi] - offset
+                take = min(avail, need)
+                if take == counts[bi] and offset == 0:
+                    parts.append(refs[bi])  # whole block: zero-copy move
+                else:
+                    parts.append(_slice_block_task.remote(
+                        refs[bi], offset, offset + take))
+                offset += take
+                need -= take
+                if offset == counts[bi]:
+                    bi += 1
+                    offset = 0
+            shards.append(Dataset(parts))
+        return shards
 
     def split_blocks(self, n: int) -> List["Dataset"]:
         """Lazy block-granular split: shard i keeps source blocks i::n and
