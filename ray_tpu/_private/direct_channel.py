@@ -658,14 +658,45 @@ class DirectManager:
     def forget_actor(self, actor_id: bytes):
         """io loop, on terminal actor death: drop per-actor bookkeeping so
         a driver churning short-lived actors doesn't grow these maps
-        forever. (Channel state itself is torn down by on_channel_down.)"""
+        forever, and run the FULL channel-down path for any live channel.
+
+        A silent close here would strand every in-flight direct task: the
+        reader thread's exception handler early-returns once ch.closed is
+        set (it assumes the closer staged the errors), so gets would hang
+        into GetTimeoutError instead of raising ActorDiedError, and the
+        dead channel would stay in self.channels blocking a restarted
+        actor's fast path."""
         self._call_counts.pop(actor_id, None)
         self._connect_backoff.pop(actor_id, None)
         self.unavailable.discard(actor_id)
         ch = self.channels.get(actor_id)
-        if ch is not None:
-            ch.closed = True
-            ch.pipe.close()
+        if ch is None:
+            return
+        already_closed = ch.closed
+        ch.closed = True
+        # Drain BEFORE closing the socket: pending_unsent marks the pipe
+        # dead, so no new frame can slip in between drain and close.
+        unsent = ch.pipe.pending_unsent()
+        ch.pipe.close()
+        if already_closed:
+            # reader (or a prior call) already ran the death path
+            self.channels.pop(actor_id, None)
+            return
+        unsent_ids = set()
+        for raw in unsent:
+            try:
+                msg = _unpack_frame_bytes(raw)
+                if msg and msg[0] == MSG_DIRECT_TASK:
+                    unsent_ids.add(msg[1]["task_id"])
+            except Exception:
+                pass
+        # Same sequence as the reader-death path: stage ActorDiedError for
+        # sent tasks so blocked fast-gets wake with a resolution (unsent
+        # tasks are re-routed, not failed), then the authoritative loop
+        # cleanup pops the channel, fails sent tasks in the memory store
+        # and re-routes the unsent specs.
+        self._stage_channel_error(ch, skip_task_ids=unsent_ids)
+        self.on_channel_down(actor_id, unsent)
 
     def notify_store(self):
         """io loop, after landing a task reply (any path) in the memory

@@ -1458,6 +1458,25 @@ class GcsServer:
                 cur["sum"] += rec.get("sum", 0.0)
         return {"ok": True}
 
+    async def handle_GetUserMetrics(self, req):
+        """Structured read of the aggregated user-metric series (the same
+        records /metrics renders) so the dashboard's /api/train and
+        /api/serve can summarize workload telemetry without scraping and
+        re-parsing Prometheus text. Optional name-prefix filter."""
+        prefix = req.get("prefix") or ""
+        out = []
+        for rec in self.user_metrics.values():
+            if prefix and not rec["name"].startswith(prefix):
+                continue
+            out.append({
+                "kind": rec["kind"], "name": rec["name"],
+                "labels": dict(rec["labels"]), "value": rec["value"],
+                "buckets": dict(rec["buckets"]), "count": rec["count"],
+                "sum": rec["sum"],
+                "boundaries": list(rec.get("boundaries") or []),
+            })
+        return {"records": out}
+
     def _collect_metrics(self) -> str:
         from ray_tpu._private.metrics import render_prometheus
 

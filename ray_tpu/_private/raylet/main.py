@@ -657,18 +657,22 @@ class NodeManager:
                 worker_env = dict(env_overrides or {})
                 if chips is not None:
                     worker_env.update(accelerators.visible_chip_env(chips))
+                handle = await self.worker_pool.pop_worker(
+                    job_id, worker_env or None
+                )
                 prestart = RTPU_CONFIG.prestart_workers_min_idle
                 if prestart > 0 and not chips:
                     # Top the warm pool back up in the background so the
                     # NEXT lease pops a booted worker (reference:
-                    # worker_pool.h:359 PrestartWorkers). Chip-bound leases
-                    # are excluded — their env is per-lease.
+                    # worker_pool.h:359 PrestartWorkers). Fired AFTER
+                    # pop_worker so the observed idle count no longer
+                    # includes the worker just taken — scheduling it before
+                    # the pop settled the pool one below the target.
+                    # Chip-bound leases are excluded — their env is
+                    # per-lease.
                     asyncio.ensure_future(self.worker_pool.prestart(
                         job_id, worker_env or None,
                         target_idle=prestart))
-                handle = await self.worker_pool.pop_worker(
-                    job_id, worker_env or None
-                )
                 if handle is None:
                     # worker failed to start; release and retry
                     pool, _ = self._pool_for(strategy)

@@ -155,6 +155,21 @@ class RpcServer:
                         dup.setblocking(True)
                         self._conns.discard(writer)
                         writer.transport.pause_reading()
+                        # drain() only waits for the buffer to fall below
+                        # the high-water mark; abort() discards whatever is
+                        # still buffered. Under a full socket buffer that
+                        # loses the upgrade response and costs the client a
+                        # timeout + backoff — wait for a true flush first.
+                        deadline = asyncio.get_running_loop().time() + 5.0
+                        while True:
+                            try:
+                                if writer.transport.get_write_buffer_size() == 0:
+                                    break
+                            except Exception:
+                                break
+                            if asyncio.get_running_loop().time() > deadline:
+                                break
+                            await asyncio.sleep(0.005)
                         # Closes the transport's fd only; the dup keeps the
                         # TCP connection alive for the adopting thread.
                         writer.transport.abort()

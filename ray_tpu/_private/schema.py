@@ -84,6 +84,7 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "GetTaskEvents": {"job_id?": (bytes, type(None)), "limit?": int},
     "GetWorkerFailures": {"limit?": int},
     "ReportUserMetrics": {"records?": list},
+    "GetUserMetrics": {"prefix?": str},
     "Ping": {},
 }
 

@@ -53,30 +53,28 @@ _INLINE = "inline"
 _ERR = "err"
 
 
-class PlasmaValueBuffer:
-    """Buffer-protocol wrapper (PEP 688) tying a plasma pin to value lifetime.
+def _pinned_buffer(mv: memoryview, handle: "_PinHandle"):
+    """Out-of-band buffer tying a plasma pin to value lifetime.
 
-    Arrays deserialized zero-copy from plasma keep a reference to their buffer;
-    when the last buffer of an object dies, the shared handle releases the
-    plasma pin so the store may reclaim the memory (matches the reference
-    plasma client's buffer refcounting, reference: plasma/client.cc).
+    Arrays deserialized zero-copy from plasma keep a reference to their
+    buffer; when the last buffer of an object dies, the shared handle
+    releases the plasma pin so the store may reclaim the memory (matches
+    the reference plasma client's buffer refcounting, plasma/client.cc).
+
+    pickle.PickleBuffer implements the buffer protocol at the C level, so
+    np.frombuffer() accepts it on every supported Python (a pure-Python
+    __buffer__ wrapper needs PEP 688, 3.12+ — on older interpreters the
+    unpickle raised, leaked the pin into the traceback, and the deferred
+    release after store teardown crashed the process). weakref.finalize
+    fires when the buffer — kept alive as the array's base — is collected.
     """
+    import pickle
+    import weakref
 
-    __slots__ = ("_mv", "_handle")
-
-    def __init__(self, mv: memoryview, handle: "_PinHandle"):
-        self._mv = mv
-        self._handle = handle
-        handle.count += 1
-
-    def __buffer__(self, flags):
-        return self._mv
-
-    def __len__(self):
-        return self._mv.nbytes
-
-    def __del__(self):
-        self._handle.dec()
+    buf = pickle.PickleBuffer(mv)
+    handle.count += 1
+    weakref.finalize(buf, handle.dec)
+    return buf
 
 
 class _PinHandle:
@@ -411,25 +409,56 @@ class CoreWorker:
                 idle_period = min(idle_period * 2, period * 8)
             self._flush_user_metrics()
 
-    def _flush_user_metrics(self):
-        """Push ray_tpu.util.metrics records (if that module is in use) to
-        the GCS aggregator, stamped with worker/job labels so series from
-        different workers never collide."""
+    def _drain_stamped_user_metrics(self):
+        """Drain ray_tpu.util.metrics records (if that module is in use),
+        stamped with worker/job labels so series from different workers
+        never collide. Returns (module, records)."""
         import sys as _sys
 
         mod = _sys.modules.get("ray_tpu.util.metrics")
         if mod is None:
-            return
+            return None, []
         try:
             records = mod.drain_records()
         except Exception:
-            return
+            return mod, []
         if not records:
-            return
+            return mod, []
         wid = self.worker_id.hex()[:12]
         jid = self.job_id.hex()
         for rec in records:
             rec["labels"] = {**rec["labels"], "WorkerId": wid, "JobId": jid}
+        return mod, records
+
+    def flush_user_metrics_sync(self, timeout: float = 5.0):
+        """Blocking metrics + task-event flush for end-of-workload barriers
+        (a train worker's final step deltas and step SPAN events must not
+        race the worker-group kill)."""
+        try:
+            events = self.task_events.drain()
+            if events:
+                self.gcs.call("AddTaskEvents", {"events": events},
+                              timeout=timeout)
+        except Exception:
+            pass
+        mod, records = self._drain_stamped_user_metrics()
+        if not records:
+            return
+        try:
+            self.gcs.call("ReportUserMetrics", {"records": records},
+                          timeout=timeout)
+        except Exception:
+            try:
+                mod.restore_records(records)
+            except Exception:
+                pass
+
+    def _flush_user_metrics(self):
+        """Push ray_tpu.util.metrics records to the GCS aggregator (async,
+        from the task-event flush loop)."""
+        mod, records = self._drain_stamped_user_metrics()
+        if not records:
+            return
 
         async def _push():
             try:
@@ -992,7 +1021,7 @@ class CoreWorker:
             (blen,) = _struct.unpack_from("<Q", src, off)
             off += 8
             off = (off + 63) & ~63
-            buffers.append(PlasmaValueBuffer(src[off : off + blen], handle))
+            buffers.append(_pinned_buffer(src[off : off + blen], handle))
             off += blen
         value, _refs = serialization.deserialize(pickle_bytes, buffers)
         del buffers
@@ -1514,7 +1543,12 @@ class CoreWorker:
                 return False
         record = self._pending_tasks.pop(spec["task_id"], None)
         for oid, result in zip(ts.return_object_ids(spec), results):
-            self.memory_store.put(oid, (_INLINE, result["inline"], None))
+            # Skip oids the reference counter no longer tracks: if the
+            # user-thread fast_get consumed the staged value and the ref
+            # already hit zero (free ran), this deferred bookkeeping would
+            # re-insert an entry for a freed object that nothing removes.
+            if self.refs.owns(oid):
+                self.memory_store.put(oid, (_INLINE, result["inline"], None))
         if record:
             self._release_task_arg_refs(record)
         if notify and self._direct is not None:
@@ -1537,12 +1571,15 @@ class CoreWorker:
             else:
                 err_payload, _ = serialization.serialize_inline(RuntimeError(reply.get("error", "task failed")))
             for oid in ts.return_object_ids(spec):
-                self.memory_store.put(oid, (_ERR, err_payload, None))
+                if self.refs.owns(oid):
+                    self.memory_store.put(oid, (_ERR, err_payload, None))
             self.task_events.record(spec, "FAILED", error=str(reply.get("error", ""))[:300])
         else:
             return_ids = ts.return_object_ids(spec)
             any_plasma = False
             for oid, result in zip(return_ids, reply["results"]):
+                if not self.refs.owns(oid):
+                    continue  # freed while in flight: don't re-insert
                 if "inline" in result:
                     self.memory_store.put(oid, (_INLINE, result["inline"], None))
                 elif "plasma" in result:

@@ -55,6 +55,48 @@ _PANELS = [
     ("Placement-group bundles", [
         ("sum(ray_tpu_node_pg_bundles)", "bundles"),
     ], "short"),
+    # ---- workload telemetry (train/_telemetry.py + serve metrics): the
+    # step-level training and request-level serving series the GCS exports
+    # from the ray_tpu.util.metrics pipeline.
+    ("Training throughput (tokens/s)", [
+        ("sum(ray_tpu_train_tokens_per_second) by (JobId)", "{{JobId}}"),
+    ], "short"),
+    ("Training step time", [
+        ("histogram_quantile(0.5, sum(rate("
+         "ray_tpu_train_step_seconds_bucket[5m])) by (le))", "p50"),
+        ("histogram_quantile(0.95, sum(rate("
+         "ray_tpu_train_step_seconds_bucket[5m])) by (le))", "p95"),
+    ], "s"),
+    ("Model FLOPs utilization", [
+        ("avg(ray_tpu_train_mfu_ratio) by (JobId)", "{{JobId}}"),
+    ], "percentunit"),
+    ("Training goodput", [
+        ("avg(ray_tpu_train_goodput_ratio) by (JobId)", "{{JobId}}"),
+    ], "percentunit"),
+    ("HBM in use", [
+        ("sum(ray_tpu_train_hbm_bytes_in_use) by (WorkerId)",
+         "{{WorkerId}}"),
+    ], "bytes"),
+    ("Serve request rate", [
+        ("sum(rate(ray_tpu_serve_requests_total[1m])) by (deployment)",
+         "{{deployment}}"),
+        ("sum(rate(ray_tpu_serve_request_errors_total[1m])) "
+         "by (deployment)", "{{deployment}} errors"),
+    ], "reqps"),
+    ("Serve request latency", [
+        ("histogram_quantile(0.5, sum(rate("
+         "ray_tpu_serve_request_latency_seconds_bucket[5m])) "
+         "by (le, deployment))", "{{deployment}} p50"),
+        ("histogram_quantile(0.99, sum(rate("
+         "ray_tpu_serve_request_latency_seconds_bucket[5m])) "
+         "by (le, deployment))", "{{deployment}} p99"),
+    ], "s"),
+    ("Serve in-flight / queue depth", [
+        ("sum(ray_tpu_serve_inflight_requests) by (deployment)",
+         "{{deployment}} in-flight"),
+        ("sum(ray_tpu_serve_queue_depth) by (deployment)",
+         "{{deployment}} queued"),
+    ], "short"),
 ]
 
 

@@ -94,6 +94,10 @@ class DashboardHead:
             return self._node_stats_api(query or {})
         if path == "/api/agent_metrics":
             return self._agent_metrics_api()
+        if path == "/api/train":
+            return self._train_api()
+        if path == "/api/serve":
+            return self._serve_api()
         if path == "/api/grafana_dashboard":
             from ray_tpu.dashboard.grafana import generate_dashboard
 
@@ -172,6 +176,146 @@ class DashboardHead:
             pid=pid, worker_id=wid, node_filter=query.get("node_id"),
             duration=duration, hz=hz,
         )
+
+    # ------------------------------------------------- workload telemetry
+
+    def _user_metrics(self, prefix: str) -> list:
+        try:
+            return self._gcs_client().call(
+                "GetUserMetrics", {"prefix": prefix}
+            ).get("records", [])
+        except Exception:
+            return []
+
+    @staticmethod
+    def _merge_hist(acc: dict, rec: dict):
+        """Merge one histogram record into an accumulator (buckets sum)."""
+        acc["count"] += rec.get("count", 0)
+        acc["sum"] += rec.get("sum", 0.0)
+        if not acc["boundaries"]:
+            acc["boundaries"] = list(rec.get("boundaries") or [])
+        for b, c in (rec.get("buckets") or {}).items():
+            acc["buckets"][b] = acc["buckets"].get(b, 0) + c
+
+    @staticmethod
+    def _hist_summary(acc: dict) -> dict:
+        """count/mean/p50/p90/p99 from merged Prometheus-style buckets.
+        Quantiles resolve to the bucket upper bound — coarse but monotone,
+        the same estimate Grafana's histogram_quantile gives."""
+        count = acc["count"]
+        out = {"count": count}
+        if not count:
+            return out
+        out["mean"] = acc["sum"] / count
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            target = q * count
+            cum = 0
+            val = None
+            for b in acc["boundaries"]:
+                cum += acc["buckets"].get(str(b), 0)
+                if cum >= target:
+                    val = b
+                    break
+            out[key] = val  # None == above the largest finite bucket
+        return out
+
+    def _train_api(self):
+        """GET /api/train: per-job training telemetry summary aggregated
+        from the ray_tpu_train_* series (train/_telemetry.py). Throughput
+        sums across workers; MFU/goodput average; step-time quantiles come
+        from the merged step histogram."""
+        jobs: dict = {}
+
+        def job(rec):
+            jid = rec["labels"].get("JobId", "")
+            return jobs.setdefault(jid, {
+                "steps": 0, "tokens_per_second": 0.0,
+                "examples_per_second": 0.0, "workers": set(),
+                "_mfu": [], "_goodput": [], "compile_seconds": 0.0,
+                "hbm_bytes_in_use": 0.0,
+                "_hist": {"count": 0, "sum": 0.0, "buckets": {},
+                          "boundaries": []},
+            })
+
+        for rec in self._user_metrics("ray_tpu_train_"):
+            j = job(rec)
+            j["workers"].add(rec["labels"].get("WorkerId", ""))
+            name = rec["name"]
+            if name == "ray_tpu_train_steps_total":
+                j["steps"] += int(rec["value"])
+            elif name == "ray_tpu_train_tokens_per_second":
+                j["tokens_per_second"] += rec["value"]
+            elif name == "ray_tpu_train_examples_per_second":
+                j["examples_per_second"] += rec["value"]
+            elif name == "ray_tpu_train_mfu_ratio":
+                j["_mfu"].append(rec["value"])
+            elif name == "ray_tpu_train_goodput_ratio":
+                j["_goodput"].append(rec["value"])
+            elif name == "ray_tpu_train_compile_seconds":
+                j["compile_seconds"] = max(j["compile_seconds"], rec["value"])
+            elif name == "ray_tpu_train_hbm_bytes_in_use":
+                j["hbm_bytes_in_use"] += rec["value"]
+            elif name == "ray_tpu_train_step_seconds":
+                self._merge_hist(j["_hist"], rec)
+        out = {}
+        for jid, j in jobs.items():
+            mfu = j.pop("_mfu")
+            goodput = j.pop("_goodput")
+            hist = j.pop("_hist")
+            j["workers"] = len(j["workers"] - {""}) or len(j["workers"])
+            if mfu:
+                j["mfu"] = sum(mfu) / len(mfu)
+            if goodput:
+                j["goodput"] = sum(goodput) / len(goodput)
+            j["step_seconds"] = self._hist_summary(hist)
+            out[jid or "unknown"] = j
+        return 200, {"jobs": out}
+
+    def _serve_api(self):
+        """GET /api/serve: per-deployment request/latency summary from the
+        ray_tpu_serve_* series (replica- and handle-side)."""
+        deps: dict = {}
+
+        def dep(rec):
+            name = rec["labels"].get("deployment", "")
+            return deps.setdefault(name, {
+                "requests_total": 0, "errors_total": 0,
+                "inflight": 0.0, "queue_depth": 0.0, "replicas": set(),
+                "_lat": {"count": 0, "sum": 0.0, "buckets": {},
+                         "boundaries": []},
+                "_handle_lat": {"count": 0, "sum": 0.0, "buckets": {},
+                                "boundaries": []},
+            })
+
+        for rec in self._user_metrics("ray_tpu_serve_"):
+            d = dep(rec)
+            name = rec["name"]
+            replica = rec["labels"].get("replica", "")
+            if replica:
+                d["replicas"].add(replica)
+            if name == "ray_tpu_serve_requests_total":
+                d["requests_total"] += int(rec["value"])
+            elif name == "ray_tpu_serve_handle_requests_total":
+                d["handle_requests_total"] = (
+                    d.get("handle_requests_total", 0) + int(rec["value"]))
+            elif name == "ray_tpu_serve_request_errors_total":
+                d["errors_total"] += int(rec["value"])
+            elif name == "ray_tpu_serve_inflight_requests":
+                d["inflight"] += rec["value"]
+            elif name == "ray_tpu_serve_queue_depth":
+                d["queue_depth"] += rec["value"]
+            elif name == "ray_tpu_serve_request_latency_seconds":
+                self._merge_hist(d["_lat"], rec)
+            elif name == "ray_tpu_serve_handle_latency_seconds":
+                self._merge_hist(d["_handle_lat"], rec)
+        out = {}
+        for name, d in deps.items():
+            d["replicas"] = len(d["replicas"])
+            d["latency_seconds"] = self._hist_summary(d.pop("_lat"))
+            d["handle_latency_seconds"] = self._hist_summary(
+                d.pop("_handle_lat"))
+            out[name or "unknown"] = d
+        return 200, {"deployments": out}
 
     def _agents(self) -> dict:
         """node_id_hex -> {host, port, pid} from the GCS agent registry

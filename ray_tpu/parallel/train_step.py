@@ -18,6 +18,7 @@ Axes (any subset may be trivial/size-1, one rule set serves all):
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -36,6 +37,33 @@ from ray_tpu.parallel.mesh import (
     batch_sharding,
     filtered_tree_shardings,
 )
+
+
+def _jit_cache_size(fn) -> int:
+    """Compiled-executable count of a jitted callable; -1 when the private
+    probe is unavailable (telemetry then falls back to first-call-only
+    compile detection)."""
+    try:
+        return fn._cache_size()
+    except Exception:
+        return -1
+
+
+def _batch_counts(batch) -> Tuple[Optional[int], Optional[int]]:
+    """(tokens, examples) in a batch dict for telemetry: the idx array's
+    element count is token count, its second-to-last dim is batch size
+    (works for (B, T) steps and (num_steps, B, T) scan stacks)."""
+    try:
+        idx = batch.get("idx")
+        if idx is None or not hasattr(idx, "shape"):
+            return None, None
+        tokens = 1
+        for d in idx.shape:
+            tokens *= int(d)
+        examples = tokens // int(idx.shape[-1]) if idx.shape[-1] else None
+        return tokens, examples
+    except Exception:
+        return None, None
 
 
 def _ring_attn_for_mesh(mesh: Mesh, seq_axis: str = "sp"):
@@ -116,6 +144,8 @@ class TrainStep:
         beta2: float = 0.95,
         grad_clip: float = 1.0,
         rules: Optional[ShardingRules] = None,
+        flops_per_step: Optional[float] = None,
+        telemetry: bool = True,
     ):
         from ray_tpu.models.gpt2_moe import GPT2MoEConfig
 
@@ -191,6 +221,24 @@ class TrainStep:
         self._traced = False
         self._multi: Dict[int, Any] = {}
         self._tiled_cache = None
+        # Step-level telemetry (train/_telemetry.py): wall time per step,
+        # compile time (jit cache misses are known exactly here), MFU from
+        # a per-model FLOPs estimate (flops_per_step overrides), goodput,
+        # HBM. Registered process-globally so session.report auto-attaches
+        # the summary. RTPU_TRAIN_TELEMETRY=0 disables.
+        self.telemetry = None
+        if telemetry:
+            from ray_tpu.train import _telemetry
+
+            self.telemetry = _telemetry.StepRecorder(
+                flops_per_step=flops_per_step,
+                flops_per_token=(
+                    None if flops_per_step is not None
+                    else _telemetry.estimate_flops_per_token(model_cfg)
+                ),
+                n_devices=mesh.devices.size,
+            )
+            _telemetry.set_current_recorder(self.telemetry)
 
     def init(self, rng) -> Dict[str, Any]:
         with self.mesh:
@@ -205,11 +253,45 @@ class TrainStep:
         # context manager costs real per-step Python time at small step
         # sizes. First call traces under the mesh (shard_map ring attention
         # resolves its axis names there), then cached dispatch skips it.
+        rec = self.telemetry
+        if rec is None:
+            if self._traced:
+                return self._step(state, batch)
+            with self.mesh:
+                out = self._step(state, batch)
+            self._traced = True
+            return out
+        t0 = time.perf_counter()
+        was_traced = self._traced
+        cache_before = _jit_cache_size(self._step)
         if self._traced:
-            return self._step(state, batch)
-        with self.mesh:
             out = self._step(state, batch)
-        self._traced = True
+        else:
+            with self.mesh:
+                out = self._step(state, batch)
+            self._traced = True
+        # Compile detection by actual jit cache miss (not just first-call):
+        # the cache key includes the ambient mesh context, so the first
+        # call after the traced flag flips recompiles too — both must be
+        # booked as compile time, not step time.
+        cache_after = _jit_cache_size(self._step)
+        compiled = (
+            cache_after != cache_before
+            if cache_before >= 0 and cache_after >= 0
+            else not was_traced
+        )
+        if compiled:
+            # Contain the whole compile + first execution in THIS record:
+            # without the sync, the async backlog drains inside the next
+            # call's dispatch and poisons its step-time measurement.
+            jax.block_until_ready(out)
+        tokens, examples = _batch_counts(batch)
+        rec.record_step(
+            time.perf_counter() - t0,
+            tokens=None if compiled else tokens,
+            examples=None if compiled else examples,
+            compile_step=compiled,
+        )
         return out
 
     def multi_step(self, state, batches, num_steps: int):
@@ -262,9 +344,34 @@ class TrainStep:
                 )
                 self._tiled_cache = (src, tiled)
             batches = self._tiled_cache[1]
+        rec = self.telemetry
+        t0 = time.perf_counter() if rec is not None else 0.0
+        cache_before = _jit_cache_size(fn) if rec is not None else -1
         if not first:
             # cached dispatch needs no ambient mesh (explicit shardings);
             # the context manager costs ~1ms/call
-            return fn(state, batches)
-        with self.mesh:
-            return fn(state, batches)
+            out = fn(state, batches)
+        else:
+            with self.mesh:
+                out = fn(state, batches)
+        if rec is not None:
+            # one recording per dispatch: the scan body runs num_steps
+            # optimizer steps inside XLA, so per-call overhead is amortized
+            cache_after = _jit_cache_size(fn)
+            compiled = (
+                cache_after != cache_before
+                if cache_before >= 0 and cache_after >= 0
+                else first
+            )
+            if compiled:
+                # drain the compile + first-chunk backlog into this record
+                # (see step()); throughput/tokens only count cached calls
+                jax.block_until_ready(out)
+                tokens = examples = None
+            else:
+                tokens, examples = _batch_counts(batches)
+            rec.record_step(
+                time.perf_counter() - t0, steps=num_steps,
+                tokens=tokens, examples=examples, compile_step=compiled,
+            )
+        return out

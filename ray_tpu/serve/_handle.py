@@ -23,6 +23,33 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 _POLL_TIMEOUT_S = 20.0
 _PROBE_TIMEOUT_S = 0.5
 
+_handle_metrics = None
+
+
+def _metrics():
+    """Caller-side serve metrics (lazy singleton). The handle lives in the
+    caller's worker process, so these flush through THAT worker's
+    util.metrics push — the latency here is the true end-to-end view
+    (routing + queueing + execution + transport), complementing the
+    replica-side ray_tpu_serve_request_latency_seconds."""
+    global _handle_metrics
+    if _handle_metrics is None:
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        _handle_metrics = {
+            "latency": Histogram(
+                "ray_tpu_serve_handle_latency_seconds",
+                "caller-observed end-to-end request latency",
+                boundaries=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                            0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+                tag_keys=("deployment",)),
+            "requests": Counter(
+                "ray_tpu_serve_handle_requests_total",
+                "requests dispatched through deployment handles",
+                tag_keys=("deployment",)),
+        }
+    return _handle_metrics
+
 
 class DeploymentResponse:
     """Future-like wrapper over the underlying ObjectRef
@@ -274,7 +301,8 @@ class DeploymentHandle:
         self._closed = True
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        deadline = time.time() + 60
+        t0 = time.time()
+        deadline = t0 + 60
         last_err: Optional[Exception] = None
         while time.time() < deadline:
             try:
@@ -296,6 +324,11 @@ class DeploymentHandle:
                             self._method, args, kwargs),
                         timeout=60,
                     )
+                    try:
+                        _metrics()["requests"].inc(
+                            1, tags={"deployment": self.deployment_name})
+                    except Exception:
+                        pass
                     return StreamingResponse(replica, sid, self, idx)
                 ref = replica.handle_request.remote(
                     self._method, args, kwargs
@@ -304,7 +337,12 @@ class DeploymentHandle:
                 # on the ref's completion via a daemon thread-free path: the
                 # response object decrements on result()).
                 resp = DeploymentResponse(ref)
-                _attach_done(resp, self, idx)
+                _attach_done(resp, self, idx, t0)
+                try:
+                    _metrics()["requests"].inc(
+                        1, tags={"deployment": self.deployment_name})
+                except Exception:
+                    pass
                 return resp
             except Exception as e:
                 last_err = e
@@ -317,9 +355,11 @@ class DeploymentHandle:
         )
 
 
-def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int):
+def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int,
+                 t0: Optional[float] = None):
     original = resp.result
     done = {"fired": False}
+    deployment = handle.deployment_name
 
     def result(timeout: Optional[float] = None):
         try:
@@ -328,5 +368,13 @@ def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int):
             if not done["fired"]:
                 done["fired"] = True
                 handle._done(idx)
+                if t0 is not None:
+                    # caller-observed e2e latency, observed once per request
+                    # at first resolution (repeat result() calls are reads)
+                    try:
+                        _metrics()["latency"].observe(
+                            time.time() - t0, tags={"deployment": deployment})
+                    except Exception:
+                        pass
 
     resp.result = result

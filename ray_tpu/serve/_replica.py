@@ -11,6 +11,46 @@ from __future__ import annotations
 import inspect
 from typing import Any
 
+_LATENCY_BOUNDARIES = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+_metrics = None
+
+
+def _serve_metrics():
+    """Replica-side request metrics (lazy singleton: one set of records per
+    replica process). They ride the worker's util.metrics flush → GCS
+    aggregation → Prometheus /metrics path — zero new transport. Metric
+    names are a stability contract (see ray_tpu/util/metrics.py)."""
+    global _metrics
+    if _metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        tags = ("deployment", "replica")
+        _metrics = {
+            "requests": Counter(
+                "ray_tpu_serve_requests_total",
+                "requests handled per deployment replica", tag_keys=tags),
+            "errors": Counter(
+                "ray_tpu_serve_request_errors_total",
+                "requests that raised per deployment replica",
+                tag_keys=tags),
+            "inflight": Gauge(
+                "ray_tpu_serve_inflight_requests",
+                "requests currently executing user code", tag_keys=tags),
+            "queue": Gauge(
+                "ray_tpu_serve_queue_depth",
+                "requests queued + executing (the router's probe depth)",
+                tag_keys=tags),
+            "latency": Histogram(
+                "ray_tpu_serve_request_latency_seconds",
+                "replica-side request latency: queue wait + execution",
+                boundaries=_LATENCY_BOUNDARIES, tag_keys=tags),
+        }
+    return _metrics
+
 
 class Replica:
     """Instantiated inside a dedicated (async, max_concurrency) actor."""
@@ -38,7 +78,9 @@ class Replica:
             self._callable = func_or_class
             self._is_function = True
         self._ongoing = 0
+        self._running = 0  # executing user code (vs queued on the gate)
         self._handled = 0
+        self._replica_tag = ""  # actor name, set by start_metrics_push
         # User-request concurrency is self-gated so the actor's
         # max_concurrency can carry headroom for control-plane methods
         # (queue_len probes, metrics) — a saturated replica must still
@@ -46,9 +88,13 @@ class Replica:
         self._max_ongoing = serialized.get("max_ongoing", 8)
         self._sem = None  # lazy: created on the actor loop
 
+    def _metric_tags(self) -> dict:
+        return {"deployment": self._name, "replica": self._replica_tag}
+
     async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         import asyncio
         import functools
+        import time as _time
 
         if self._sem is None:
             self._sem = asyncio.Semaphore(self._max_ongoing)
@@ -57,6 +103,9 @@ class Replica:
             from ray_tpu.serve.multiplex import _set_current_model_id
 
             _set_current_model_id(model_id)
+        t0 = _time.perf_counter()
+        metrics = _serve_metrics()
+        tags = self._metric_tags()
         # _ongoing counts queued + running: the probe's notion of depth
         self._ongoing += 1
         try:
@@ -64,6 +113,7 @@ class Replica:
         except BaseException:
             self._ongoing -= 1
             raise
+        self._running += 1
         try:
             if self._is_function:
                 target = self._callable
@@ -88,10 +138,18 @@ class Replica:
             if inspect.iscoroutine(result):
                 result = await result
             return result
+        except BaseException:
+            metrics["errors"].inc(1, tags=tags)
+            raise
         finally:
             self._sem.release()
+            self._running -= 1
             self._ongoing -= 1
             self._handled += 1
+            # Replica-side end-to-end latency: queue wait + execution
+            # (the handle records the caller-side view separately).
+            metrics["requests"].inc(1, tags=tags)
+            metrics["latency"].observe(_time.perf_counter() - t0, tags=tags)
 
     # ------------------------------------------------------------ streaming
 
@@ -135,6 +193,7 @@ class Replica:
             # in next_stream_items' task context, not this one
             self._streams[sid] = {"gen": gen, "model_id": model_id,
                                   "last_pull": _time.time()}
+            _serve_metrics()["requests"].inc(1, tags=self._metric_tags())
             return sid
         except BaseException:
             self._sem.release()
@@ -240,6 +299,9 @@ class Replica:
         if getattr(self, "_push_task", None) is not None:
             return
         self._replica_name = replica_name
+        # short tag: SERVE_REPLICA::<dep>::<id> -> <dep>#<id> keeps the
+        # Prometheus label readable and the series cardinality = replicas
+        self._replica_tag = replica_name.split("::")[-1]
 
         async def _loop():
             import ray_tpu
@@ -261,6 +323,16 @@ class Replica:
                         healthy = True
                     except Exception:
                         healthy = False
+                try:
+                    # queue/in-flight gauges ride the same 0.5s cadence as
+                    # the controller push; exported via the worker's
+                    # util.metrics flush → GCS → Prometheus
+                    m = _serve_metrics()
+                    tags = self._metric_tags()
+                    m["queue"].set(self._ongoing, tags=tags)
+                    m["inflight"].set(self._running, tags=tags)
+                except Exception:
+                    pass
                 try:
                     if controller is None:
                         controller = ray_tpu.get_actor(CONTROLLER_NAME)

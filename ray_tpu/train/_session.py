@@ -107,6 +107,10 @@ def init_session(ctx: TrainContext, checkpoint: Optional[Checkpoint],
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  pipeline_depth: int = 1) -> _Session:
     global _session
+    # A reused worker process must not report the previous run's telemetry.
+    from ray_tpu.train import _telemetry
+
+    _telemetry.set_current_recorder(None)
     with _session_lock:
         _session = _Session(ctx, checkpoint, dataset_shards, pipeline_depth)
         return _session
@@ -136,6 +140,15 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
     s = get_session()
     if s is None:
         raise RuntimeError("ray_tpu.train.report() outside a train worker")
+    # Auto-attach step telemetry (train/_telemetry.py): if this worker runs
+    # a TrainStep (or registered a StepRecorder), every report carries the
+    # rolling step-time/MFU/goodput/throughput summary under telemetry/*
+    # keys — user metrics always win on collision.
+    from ray_tpu.train import _telemetry
+
+    auto = _telemetry.auto_report_metrics()
+    if auto:
+        metrics = {**auto, **metrics}
     s.report(metrics, checkpoint)
 
 

@@ -122,6 +122,13 @@ class DataParallelTrainer:
             raise TrainingFailedError(
                 f"training failed (trial {r.trial_id}):\n{r.error}"
             )
+        # Final telemetry gauges re-published from THIS process: the GCS
+        # drops dead workers' gauges, and the trial/train actors are gone
+        # by now — the driver keeps the run's summary scrapeable.
+        from ray_tpu.train import _telemetry
+
+        _telemetry.publish_report_summary(
+            dict(r.metrics or {}), os.path.basename(self.experiment_dir))
         return Result(
             metrics=dict(r.metrics or {}),
             # The trial persisted its own copy of the latest checkpoint the
@@ -333,6 +340,12 @@ class DataParallelTrainer:
         lead = reports[min(reports)]["metrics"]
         rs["last_metrics"] = lead
         metrics_history.append(lead)
+        # live per-round gauges from the polling process (it outlives the
+        # workers, so the series survive worker-group shutdown)
+        from ray_tpu.train import _telemetry
+
+        _telemetry.publish_report_summary(
+            lead, os.path.basename(self.experiment_dir))
         ckpt_worker, ckpt_path = next(
             ((i, r["checkpoint_path"]) for i, r in reports.items()
              if "checkpoint_path" in r), (None, None),

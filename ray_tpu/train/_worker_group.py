@@ -129,6 +129,17 @@ class _TrainWorker:
                 session.error = e
                 session.error_tb = traceback.format_exc()
             finally:
+                # Flush telemetry/user metrics BEFORE signaling finished:
+                # the driver kills the group right after consuming the
+                # finished report, and the 1s async flush cadence would
+                # lose the run's final step deltas.
+                try:
+                    from ray_tpu._private import worker as worker_mod
+
+                    if worker_mod.global_worker is not None:
+                        worker_mod.global_worker.flush_user_metrics_sync()
+                except Exception:
+                    pass
                 session.finished = True
                 # wake any blocked report consumer hand-off
                 session.reports.put(None)

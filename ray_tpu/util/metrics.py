@@ -5,6 +5,34 @@ Metric updates are recorded in-process and pushed to the GCS with the
 periodic task-event flush; the GCS aggregates them (summing counters,
 last-write gauges, bucket-merging histograms) and exports everything on its
 Prometheus /metrics endpoint.
+
+Metric-name stability contract
+------------------------------
+The framework's own workload series are a public interface: dashboards,
+alerts and the ``/api/train`` / ``/api/serve`` summaries key on these exact
+names and label keys, so renaming or re-labeling any of them is a breaking
+change (add new series instead). The stable set:
+
+  training (train/_telemetry.py, labels: run, +WorkerId/JobId at flush)
+    ray_tpu_train_step_seconds         histogram, wall time per step
+    ray_tpu_train_steps_total          counter
+    ray_tpu_train_tokens_per_second    gauge
+    ray_tpu_train_examples_per_second  gauge
+    ray_tpu_train_mfu_ratio            gauge, 0-1
+    ray_tpu_train_goodput_ratio        gauge, 0-1
+    ray_tpu_train_compile_seconds      gauge, cumulative
+    ray_tpu_train_last_step_seconds    gauge (driver-side re-publish)
+    ray_tpu_train_hbm_bytes_in_use     gauge, labels +device (TPU only)
+
+  serving (serve/_replica.py + serve/_handle.py, labels: deployment
+  [, replica])
+    ray_tpu_serve_requests_total                 counter
+    ray_tpu_serve_request_errors_total           counter
+    ray_tpu_serve_inflight_requests              gauge
+    ray_tpu_serve_queue_depth                    gauge
+    ray_tpu_serve_request_latency_seconds        histogram (replica-side)
+    ray_tpu_serve_handle_latency_seconds         histogram (caller-side)
+    ray_tpu_serve_handle_requests_total          counter
 """
 
 from __future__ import annotations

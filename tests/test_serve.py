@@ -225,3 +225,95 @@ def _done(resp):
         return True
     except Exception:
         return False
+
+
+@pytest.mark.fast
+def test_request_metrics_and_latency_histogram_export(serve_cluster):
+    """Serve telemetry: per-deployment request counters, queue/in-flight
+    gauges and latency histograms flow replica -> worker metrics flush ->
+    GCS -> Prometheus /metrics, and /api/serve summarizes them."""
+    serve = serve_cluster
+
+    @serve.deployment
+    def tick(x):
+        time.sleep(0.01)
+        return x
+
+    handle = serve.run(tick.bind(), name="metrics", route_prefix="/metrics-app")
+    for i in range(6):
+        assert handle.remote(i).result(timeout=30) == i
+
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    port = w.gcs.ping()["metrics_port"]
+    deadline = time.time() + 30
+    text = ""
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        if ("ray_tpu_serve_request_latency_seconds_bucket" in text
+                and "ray_tpu_serve_handle_latency_seconds_bucket" in text):
+            break
+        time.sleep(0.5)
+    # replica-side series, labeled by deployment
+    assert 'ray_tpu_serve_requests_total{' in text
+    assert 'deployment="metrics#tick"' in text
+    assert "ray_tpu_serve_request_latency_seconds_bucket" in text
+    assert "ray_tpu_serve_request_latency_seconds_count" in text
+    # caller-side end-to-end histogram (flushed by the driver worker)
+    assert "ray_tpu_serve_handle_latency_seconds_bucket" in text
+    # gauges ride the replica's 0.5s push loop
+    assert "ray_tpu_serve_queue_depth" in text
+    assert "ray_tpu_serve_inflight_requests" in text
+
+    # structured summary over the same series
+    from ray_tpu.dashboard.head import DashboardHead
+
+    head = DashboardHead(w.gcs.address)
+    status, payload = head._collect("/api/serve", "GET", None, {})
+    assert status == 200
+    dep = payload["deployments"]["metrics#tick"]
+    assert dep["requests_total"] >= 6
+    assert dep["errors_total"] == 0
+    assert dep["replicas"] >= 1
+    lat = dep["latency_seconds"]
+    assert lat["count"] >= 6
+    assert lat["mean"] >= 0.01 * 0.5
+    assert lat["p50"] is not None
+
+
+@pytest.mark.fast
+def test_request_error_counter(serve_cluster):
+    serve = serve_cluster
+
+    @serve.deployment
+    def boom():
+        raise ValueError("nope")
+
+    handle = serve.run(boom.bind(), name="errs", route_prefix="/errs")
+    for _ in range(2):
+        try:
+            handle.remote().result(timeout=30)
+            assert False, "expected error"
+        except Exception:
+            pass
+
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker
+    deadline = time.time() + 30
+    recs = []
+    while time.time() < deadline:
+        recs = [
+            r for r in w.gcs.call(
+                "GetUserMetrics",
+                {"prefix": "ray_tpu_serve_request_errors_total"},
+            )["records"]
+            if r["labels"].get("deployment") == "errs#boom"
+        ]
+        if recs and sum(r["value"] for r in recs) >= 2:
+            break
+        time.sleep(0.5)
+    assert recs and sum(r["value"] for r in recs) >= 2

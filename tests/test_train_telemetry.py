@@ -1,0 +1,352 @@
+"""Step-level training telemetry (train/_telemetry.py): recorder math with
+a fake clock, metric export through util.metrics, HBM absent-on-CPU,
+TrainStep integration, session.report auto-attach, and SPAN events landing
+in the timeline dump.
+
+CPU-only (JAX_PLATFORMS=cpu via conftest); everything here rides the fast
+marker — the cluster tests use the tiniest possible model/loops.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ray_tpu.train._telemetry import (
+    StepRecorder,
+    estimate_flops_per_token,
+    peak_flops_per_device,
+    set_current_recorder,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _recorder(clock, **kw):
+    kw.setdefault("publish_interval_s", 0.0)
+    kw.setdefault("devices", [])
+    kw.setdefault("emit_spans", False)
+    return StepRecorder(clock=clock, wall_clock=clock, **kw)
+
+
+@pytest.mark.fast
+def test_goodput_and_throughput_math():
+    clk = FakeClock()
+    rec = _recorder(clk)
+    # compile call: 2s, booked as compile not productive
+    clk.advance(2.0)
+    rec.record_step(2.0, compile_step=True)
+    # 8 productive steps of 0.25s, back to back
+    for _ in range(8):
+        clk.advance(0.25)
+        rec.record_step(0.25, tokens=1024, examples=8)
+    assert rec.steps == 9
+    assert rec.productive_steps == 8
+    assert rec.compile_s == pytest.approx(2.0)
+    assert rec.productive_s == pytest.approx(2.0)
+    # elapsed 4s, productive 2s
+    assert rec.goodput() == pytest.approx(0.5)
+    assert rec.tokens_per_second() == pytest.approx(8 * 1024 / 2.0)
+    assert rec.examples_per_second() == pytest.approx(32.0)
+    # a 4s stall (driver pause / restart) halves goodput again
+    clk.advance(4.0)
+    assert rec.goodput() == pytest.approx(0.25)
+    s = rec.summary()
+    assert s["steps"] == 9
+    assert s["step_time_s"] == pytest.approx(0.25)
+    assert s["compile_time_s"] == pytest.approx(2.0)
+
+
+@pytest.mark.fast
+def test_mfu_from_flops_per_step():
+    clk = FakeClock()
+    rec = _recorder(clk, flops_per_step=1e9, peak_flops=1e12, n_devices=2)
+    clk.advance(1.0)
+    rec.record_step(1.0, compile_step=True)
+    for _ in range(4):
+        clk.advance(0.5)
+        rec.record_step(0.5)
+    # 4 steps * 1e9 FLOPs over 2s on 2 chips of 1e12 peak
+    assert rec.mfu() == pytest.approx(4e9 / 2.0 / 2e12)
+    # multi-step scan records count as `steps` optimizer steps
+    clk.advance(1.0)
+    rec.record_step(1.0, steps=10)
+    assert rec.productive_steps == 14
+    assert rec.mfu() == pytest.approx(14e9 / 3.0 / 2e12)
+
+
+@pytest.mark.fast
+def test_mfu_from_flops_per_token_and_unknown_device():
+    clk = FakeClock()
+    rec = _recorder(clk, flops_per_token=6e6, peak_flops=1e12, n_devices=1)
+    clk.advance(0.5)
+    rec.record_step(0.5, tokens=2000)
+    assert rec.mfu() == pytest.approx(6e6 * 2000 / 0.5 / 1e12)
+    # no peak (CPU device kind) -> MFU honestly absent, not fabricated
+    rec2 = _recorder(clk, flops_per_step=1e9)
+    rec2.record_step(0.5)
+    assert rec2.mfu() is None
+    assert peak_flops_per_device("cpu") is None
+    assert peak_flops_per_device("TPU v4") == pytest.approx(275e12)
+
+
+@pytest.mark.fast
+def test_flops_estimate_from_model_config():
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    cfg = GPT2Config.tiny()
+    est = estimate_flops_per_token(cfg)
+    # 6 * (12 L d^2 + vocab d) for tiny: L=2, d=128, vocab=512
+    assert est == pytest.approx(6 * (12 * 2 * 128 * 128 + 512 * 128))
+    assert estimate_flops_per_token(object()) is None
+
+
+@pytest.mark.fast
+def test_hbm_gauge_absent_on_cpu():
+    """device.memory_stats() returns None on CPU — the recorder must not
+    crash nor emit an HBM gauge."""
+    import jax
+
+    clk = FakeClock()
+    rec = StepRecorder(clock=clk, wall_clock=clk, publish_interval_s=0.0,
+                       devices=jax.local_devices(), emit_spans=False)
+    rec.record_step(0.1)
+    assert rec.hbm_bytes_in_use() == {}
+    assert "hbm_bytes_in_use" not in rec.summary()
+
+
+@pytest.mark.fast
+def test_hbm_gauge_present_with_stats():
+    class FakeDev:
+        platform = "tpu"
+        id = 0
+        device_kind = "TPU v5e"
+
+        def memory_stats(self):
+            return {"bytes_in_use": 123456}
+
+    clk = FakeClock()
+    rec = StepRecorder(clock=clk, wall_clock=clk, publish_interval_s=0.0,
+                       devices=[FakeDev()], emit_spans=False)
+    rec.record_step(0.1)
+    assert rec.hbm_bytes_in_use() == {"tpu:0": 123456.0}
+    assert rec.summary()["hbm_bytes_in_use"] == 123456.0
+
+
+@pytest.mark.fast
+def test_metrics_reach_util_metrics_records():
+    from ray_tpu.util import metrics as um
+
+    um.drain_records()  # isolate from other tests' leftovers
+    clk = FakeClock()
+    rec = _recorder(clk, flops_per_step=1e9, peak_flops=1e12, n_devices=1)
+    clk.advance(1.0)
+    rec.record_step(1.0, compile_step=True)
+    for _ in range(3):
+        clk.advance(0.2)
+        rec.record_step(0.2, tokens=100, examples=2)
+    by_name = {}
+    for r in um.drain_records():
+        by_name.setdefault(r["name"], r)
+    assert by_name["ray_tpu_train_steps_total"]["value"] == 4
+    assert by_name["ray_tpu_train_step_seconds"]["count"] == 3
+    assert by_name["ray_tpu_train_step_seconds"]["sum"] == pytest.approx(0.6)
+    assert by_name["ray_tpu_train_goodput_ratio"]["value"] == pytest.approx(
+        0.6 / 1.6)
+    assert by_name["ray_tpu_train_tokens_per_second"]["value"] == pytest.approx(
+        300 / 0.6)
+    assert by_name["ray_tpu_train_mfu_ratio"]["value"] == pytest.approx(
+        3e9 / 0.6 / 1e12)
+    assert by_name["ray_tpu_train_compile_seconds"]["value"] == pytest.approx(
+        1.0)
+
+
+@pytest.mark.fast
+def test_session_report_auto_attaches_telemetry():
+    from ray_tpu.train._session import (
+        TrainContext, init_session, report, shutdown_session,
+    )
+
+    clk = FakeClock()
+    s = init_session(TrainContext(0, 1, 0, 1, "127.0.0.1"), None,
+                     pipeline_depth=4)
+    try:
+        rec = _recorder(clk)
+        set_current_recorder(rec)
+        clk.advance(0.5)
+        rec.record_step(0.5, tokens=64)
+        report({"loss": 1.5, "telemetry/goodput": "user-wins"})
+        item = s.reports.get_nowait()
+        m = item["metrics"]
+        assert m["loss"] == 1.5
+        assert m["telemetry/steps"] == 1
+        assert m["telemetry/tokens_per_s"] == pytest.approx(128.0)
+        # user-provided keys always win over auto-attached ones
+        assert m["telemetry/goodput"] == "user-wins"
+    finally:
+        set_current_recorder(None)
+        shutdown_session()
+
+
+@pytest.mark.fast
+def test_train_step_records_compile_and_steps():
+    """TrainStep books jit cache misses as compile time (both the first
+    trace AND the ambient-mesh-context recompile), and productive steps
+    carry token counts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.parallel.train_step import TrainStep
+
+    cfg = GPT2Config.tiny(use_flash_attention=False, dtype=jnp.float32)
+    ts = TrainStep(cfg, make_mesh({"dp": 8}), learning_rate=1e-3)
+    assert ts.telemetry is not None
+    from ray_tpu.train._telemetry import current_recorder
+
+    assert current_recorder() is ts.telemetry
+    state = ts.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, cfg.vocab_size, size=(8, 32)).astype(np.int32)
+    batch = {"idx": jnp.asarray(idx),
+             "targets": jnp.asarray(np.roll(idx, -1, 1))}
+    for _ in range(4):
+        state, _ = ts.step(state, ts.shard_batch(batch))
+    rec = ts.telemetry
+    assert rec.steps == 4
+    assert rec.compile_s > 0
+    assert rec.productive_steps >= 2  # at most 2 calls were cache misses
+    assert rec.productive_s > 0
+    assert rec.tokens == 8 * 32 * rec.productive_steps
+    # CPU: no HBM stats, no MFU (unknown peak) — absent, not wrong
+    assert rec.hbm_bytes_in_use() == {}
+    s = rec.summary()
+    assert s["goodput"] <= 1.0
+
+
+@pytest.mark.fast
+def test_telemetry_opt_out():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.parallel.train_step import TrainStep
+
+    cfg = GPT2Config.tiny(use_flash_attention=False, dtype=jnp.float32)
+    ts = TrainStep(cfg, make_mesh({"dp": 8}), telemetry=False)
+    assert ts.telemetry is None
+
+
+def test_step_spans_reach_timeline_dump(ray_start_regular, tmp_path):
+    """Per-step SPAN events flow task-events -> GCS -> timeline(): the
+    Chrome trace must contain train_step spans with durations."""
+    import ray_tpu
+
+    rec = StepRecorder(publish_interval_s=0.0, devices=[])
+    rec.record_step(0.5, compile_step=True)
+    for _ in range(3):
+        rec.record_step(0.02, tokens=256)
+    out = tmp_path / "trace.json"
+    deadline = time.time() + 20
+    spans = []
+    while time.time() < deadline:
+        ray_tpu.timeline(str(out))
+        events = json.loads(out.read_text())
+        spans = [e for e in events
+                 if e.get("cat") == "span"
+                 and str(e.get("name", "")).startswith("train_step")]
+        if len(spans) >= 4:
+            break
+        time.sleep(0.3)
+    assert len(spans) >= 4
+    compile_spans = [e for e in spans if e["name"] == "train_step.compile"]
+    assert compile_spans and compile_spans[0]["dur"] == pytest.approx(
+        0.5e6, rel=0.01)
+    step_spans = [e for e in spans if e["name"] == "train_step"]
+    assert step_spans[0]["args"]["tokens"] == "256"
+
+
+def test_trainer_run_exports_prometheus_metrics(ray_start_regular, tmp_path):
+    """Acceptance: a CPU-only JaxTrainer run followed by a GCS /metrics
+    scrape shows the ray_tpu_train_* series, and the dashboard /api/train
+    summarizes them per job."""
+    import ray_tpu
+    from ray_tpu import train
+    from ray_tpu.train import JaxConfig, JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models.gpt2 import GPT2Config
+        from ray_tpu.parallel.mesh import make_mesh
+        from ray_tpu.parallel.train_step import TrainStep
+
+        cfg = GPT2Config.tiny(use_flash_attention=False, dtype=jnp.float32)
+        ts = TrainStep(cfg, make_mesh({"dp": 1}, devices=jax.devices()[:1]),
+                       learning_rate=1e-3)
+        state = ts.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        idx = rng.integers(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+        batch = {"idx": jnp.asarray(idx),
+                 "targets": jnp.asarray(np.roll(idx, -1, 1))}
+        for _ in range(3):
+            state, m = ts.step(state, ts.shard_batch(batch))
+        train.report({"loss": float(m["loss"])})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="telem"),
+        jax_config=JaxConfig(distributed=False),
+    )
+    result = trainer.fit()
+    # report() auto-attached the telemetry summary
+    assert result.metrics["telemetry/steps"] == 3
+    assert 0 < result.metrics["telemetry/goodput"] <= 1.0
+    assert result.metrics["telemetry/tokens_per_s"] > 0
+
+    from ray_tpu._private import worker as worker_mod
+
+    port = worker_mod.global_worker.gcs.ping()["metrics_port"]
+    deadline = time.time() + 25
+    text = ""
+    while time.time() < deadline:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        if "ray_tpu_train_step_seconds" in text:
+            break
+        time.sleep(0.5)
+    assert "ray_tpu_train_step_seconds_bucket" in text
+    assert "ray_tpu_train_steps_total" in text
+    assert "ray_tpu_train_tokens_per_second" in text
+    assert "ray_tpu_train_goodput_ratio" in text
+
+    # dashboard /api/train aggregates the same series per job
+    from ray_tpu.dashboard.head import DashboardHead
+
+    head = DashboardHead(worker_mod.global_worker.gcs.address)
+    status, payload = head._collect("/api/train", "GET", None, {})
+    assert status == 200
+    jobs = payload["jobs"]
+    assert jobs, "no jobs in /api/train"
+    job = next(iter(jobs.values()))
+    assert job["steps"] >= 3
+    assert job["tokens_per_second"] > 0
+    assert job["step_seconds"]["count"] >= 1
+    assert job["step_seconds"]["p50"] is not None
