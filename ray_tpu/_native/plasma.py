@@ -164,13 +164,18 @@ class PlasmaClient:
         self._libref.ps_release(self._handle, self._id_bytes(object_id))
 
     def put_blob(self, object_id, data) -> bool:
-        """Create+copy+seal in one step. Returns False if it already existed."""
-        data = memoryview(data).cast("B")
+        """Create+copy+seal in one step — the single copy of the store's
+        zero-copy discipline (callers hand raw views, never pre-materialized
+        bytes; the hot path streams via serialization.write_blob instead).
+        Returns False if it already existed."""
+        data = memoryview(data)
+        nbytes = data.nbytes
         try:
-            dest = self.create(object_id, data.nbytes)
+            dest = self.create(object_id, nbytes)
         except FileExistsError:
             return False
-        dest[:] = data
+        if nbytes:  # cast("B") rejects empty multi-dim views
+            dest[:] = data.cast("B")
         dest.release()
         self.seal(object_id)
         return True

@@ -285,7 +285,10 @@ class Executor:
         if all(size <= self.core.inline_threshold for _, size in payloads):
             self.core.task_events.record(spec, "FINISHED")
             return {"status": "ok",
-                    "results": [{"inline": p} for p, _ in payloads]}
+                    "results": [
+                        {"inline": serialization.inline_payload(p, bufs)}
+                        for (p, bufs), _ in payloads
+                    ]}
         return ("plasma", payloads)
 
     async def _finish_deferred(self, spec: dict, fut: asyncio.Future, payloads):
@@ -598,9 +601,12 @@ class Executor:
                 )
         out = []
         for value in values:
-            payload, _refs = serialization.serialize_inline(value)
-            size = len(payload["p"]) + sum(len(b) for b in payload["b"])
-            out.append((payload, size))
+            # Keep the raw protocol-5 buffer views: plasma-bound returns
+            # stream them straight into shm (put_return_to_plasma) and only
+            # inline returns materialize bytes (_finish_results).
+            p, bufs, _refs = serialization.serialize(value)
+            size = len(p) + serialization.buffers_nbytes(bufs)
+            out.append(((p, bufs), size))
         return out
 
     async def _finish_results(self, spec: dict, payloads: list) -> dict:
@@ -608,11 +614,11 @@ class Executor:
         the plasma path needs it)."""
         return_ids = return_object_ids(spec)
         results = []
-        for oid, (payload, size) in zip(return_ids, payloads):
+        for oid, ((p, bufs), size) in zip(return_ids, payloads):
             if size <= self.core.inline_threshold:
-                results.append({"inline": payload})
+                results.append({"inline": serialization.inline_payload(p, bufs)})
             else:
-                meta = await self.core.put_return_to_plasma(oid, payload, spec)
+                meta = await self.core.put_return_to_plasma(oid, (p, bufs), spec)
                 results.append({"plasma": meta})
         self.core.task_events.record(spec, "FINISHED")
         return {"status": "ok", "results": results}

@@ -35,7 +35,7 @@ from ray_tpu._private.gcs.client import GcsAioClient
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.raylet.resources import ResourceSet
 from ray_tpu._private.raylet.worker_pool import WorkerPool
-from ray_tpu._private.rpc import ClientPool, RpcServer
+from ray_tpu._private.rpc import ClientPool, OobPayload, RpcServer
 
 import msgpack
 
@@ -130,6 +130,10 @@ class NodeManager:
 
     async def start(self, port: int = 0) -> int:
         self.server.register_all(self)
+        # Inbound push chunks stream from the socket straight into the
+        # pre-created plasma buffer at their offset (zero intermediate
+        # buffering) — see _receive_chunk_sink.
+        self.server.set_oob_sink("ReceiveChunk", self._receive_chunk_sink)
         port = await self.server.start(port)
         self.port = port
         self.worker_pool = WorkerPool(
@@ -1279,7 +1283,9 @@ class NodeManager:
     # ----------------------------------------------------- spilling / OOM
 
     @staticmethod
-    def _write_spill_file(path: str, data: bytes):
+    def _write_spill_file(path: str, data):
+        """data is any bytes-like — the plasma view itself is passed so the
+        spill write streams shm -> page cache with no heap copy."""
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
@@ -1317,9 +1323,10 @@ class NodeManager:
                 if rec is None:
                     path = os.path.join(self._spill_dir, oid.hex())
                     try:
-                        data = bytes(view)
+                        # the pin (self._pinned) holds the view alive for
+                        # the duration of the executor write — no bytes()
                         await loop.run_in_executor(
-                            None, self._write_spill_file, path, data
+                            None, self._write_spill_file, path, view
                         )
                     except Exception:
                         logger.exception("spill of %s failed", oid.hex()[:12])
@@ -1374,11 +1381,15 @@ class NodeManager:
             logger.warning("restore of %s: no room after retries", oid.hex()[:12])
             return False
         loop = asyncio.get_running_loop()
+
+        def _read_into():
+            # page cache -> plasma shm directly; no intermediate bytes
+            with open(path, "rb") as f:
+                if f.readinto(dest) != size:
+                    raise RuntimeError(f"spill file {path} truncated")
+
         try:
-            data = await loop.run_in_executor(
-                None, lambda: open(path, "rb").read()
-            )
-            dest[:] = data
+            await loop.run_in_executor(None, _read_into)
             dest.release()
             self.plasma.seal(oid)
         except Exception:
@@ -1643,14 +1654,23 @@ class NodeManager:
                         return f.read(size)
 
                 try:
-                    return {"found": True, "data": await loop.run_in_executor(None, _read)}
+                    data = await loop.run_in_executor(None, _read)
                 except OSError:
                     return {"found": False}
+                # raw after the header — no msgpack encode of the bulk
+                return OobPayload({"found": True}, data)
             return {"found": False}
-        data = bytes(view[off : off + size])
-        view.release()
-        self.plasma.release(oid)
-        return {"found": True, "data": data}
+
+        def _release(v=view, o=oid):
+            try:
+                v.release()
+            except Exception:
+                pass
+            self.plasma.release(o)
+
+        # the plasma view slice itself goes on the wire (no bytes() copy);
+        # the pin drops once the frame is handed to the transport
+        return OobPayload({"found": True}, view[off:off + size], release=_release)
 
     # ------------------------------------------------- push path (outbound)
 
@@ -1696,26 +1716,46 @@ class NodeManager:
 
             async def send_one(offset):
                 n = min(chunk, size - offset)
-                # materialize the chunk INSIDE the window: reading all
-                # chunks up front would copy the whole object onto the heap
-                # at once — the window bounds memory to 8 chunks
                 async with sem:
                     if view is not None:
-                        data = bytes(view[offset:offset + n])
+                        # zero-copy: the plasma view slice rides raw after
+                        # the out-of-band frame header — never bytes()'d,
+                        # never msgpack-encoded
+                        r = await peer.call(
+                            "ReceiveChunk",
+                            {"object_id": oid, "offset": offset},
+                            timeout=60,
+                            oob=view[offset:offset + n],
+                        )
                     else:
                         spilled = self._spilled.get(oid)
+                        if spilled is None:
+                            # restored or freed mid-transfer: the spill
+                            # file is gone — fail THIS push cleanly; the
+                            # outer handler turns it into {"ok": False}
+                            raise RuntimeError(
+                                f"source for {oid.hex()[:12]} vanished "
+                                "mid-push (spilled copy restored or freed)"
+                            )
+                        # one copy (page cache -> buf), then raw send; the
+                        # window bounds memory to 8 chunks
+                        buf = bytearray(n)
 
-                        def _read(path=spilled[0], off=offset, ln=n):
+                        def _read(path=spilled[0], off=offset, b=buf):
                             with open(path, "rb") as f:
                                 f.seek(off)
-                                return f.read(ln)
+                                if f.readinto(b) != len(b):
+                                    raise RuntimeError(
+                                        "spill file truncated mid-push"
+                                    )
 
-                        data = await loop.run_in_executor(None, _read)
-                    r = await peer.call(
-                        "ReceiveChunk",
-                        {"object_id": oid, "offset": offset, "data": data},
-                        timeout=60,
-                    )
+                        await loop.run_in_executor(None, _read)
+                        r = await peer.call(
+                            "ReceiveChunk",
+                            {"object_id": oid, "offset": offset},
+                            timeout=60,
+                            oob=buf,
+                        )
                 return bool(r.get("ok"))
 
             oks = await asyncio.gather(
@@ -1736,15 +1776,46 @@ class NodeManager:
 
     def _abort_recv(self, oid: bytes):
         rec = self._recv.pop(oid, None)
-        if rec is not None:
-            try:
-                rec["view"].release()
-            except Exception:
-                pass
-            try:
-                self.plasma.abort(oid)
-            except Exception:
-                pass
+        if rec is None:
+            return
+        if rec.get("landing", 0) > 0:
+            # a chunk is streaming into the buffer right now (oob sink) —
+            # defer the plasma abort until the last lander finishes so the
+            # store can't hand this memory to a new object mid-write
+            rec["abort_pending"] = True
+            return
+        self._finish_abort_recv(oid, rec)
+
+    def _finish_abort_recv(self, oid: bytes, rec: dict):
+        try:
+            rec["view"].release()
+        except Exception:
+            pass
+        try:
+            self.plasma.abort(oid)
+        except Exception:
+            pass
+
+    def _receive_chunk_sink(self, payload, nbytes: int):
+        """RpcServer oob sink: hand back the pre-created plasma buffer slice
+        at the chunk's offset so the raw payload streams from the socket
+        straight into shared memory — no intermediate chunk buffer."""
+        rec = self._recv.get(payload.get("object_id"))
+        if rec is None:
+            return None
+        off = payload.get("offset")
+        if not isinstance(off, int) or off < 0 or off + nbytes > rec["size"]:
+            return None
+        rec["landing"] = rec.get("landing", 0) + 1
+        rec["t"] = time.time()
+
+        def done(ok, oid=payload["object_id"], rec=rec):
+            rec["landing"] -= 1
+            rec["t"] = time.time()
+            if rec.get("abort_pending") and rec["landing"] <= 0:
+                self._finish_abort_recv(oid, rec)
+
+        return rec["view"][off:off + nbytes], done
 
     async def handle_ReceiveBegin(self, req):
         oid = req["object_id"]
@@ -1783,7 +1854,17 @@ class NodeManager:
         rec = self._recv.get(req["object_id"])
         if rec is None:
             return {"ok": False}
-        off, data = req["offset"], req["data"]
+        oob = req.get("_oob")
+        if isinstance(oob, int):
+            # the oob sink already streamed the chunk into the plasma
+            # buffer at its offset — nothing left to copy
+            return {"ok": True}
+        data = oob if oob is not None else req.get("data")
+        if data is None:
+            return {"ok": False, "error": "no chunk payload"}
+        off = req["offset"]
+        if off < 0 or off + len(data) > rec["size"]:
+            return {"ok": False, "error": "chunk out of bounds"}
         rec["view"][off:off + len(data)] = data
         rec["t"] = time.time()
         return {"ok": True}
@@ -1924,15 +2005,27 @@ class NodeManager:
             async with sem:
                 for peer in order:
                     try:
+                        # oob_dest: the holder's out-of-band response frame
+                        # streams from the socket straight into OUR plasma
+                        # buffer at this chunk's offset — no staging buffer.
+                        # (A timed-out call unregisters the dest; a response
+                        # landing from a retried peer writes the same bytes.)
                         r = await peer.call(
                             "FetchChunk",
                             {"object_id": oid, "offset": off, "size": n},
                             timeout=60,
+                            oob_dest=dest[off:off + n],
                         )
                     except Exception:
                         continue
                     if r.get("found"):
-                        dest[off:off + n] = r["data"]
+                        oob = r.get("_oob")
+                        if oob == n:
+                            return True  # landed in place
+                        data = oob if oob is not None else r.get("data")
+                        if data is None or len(data) != n:
+                            continue
+                        dest[off:off + n] = data
                         return True
                 return False
 

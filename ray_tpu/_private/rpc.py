@@ -14,6 +14,21 @@ Frame layout: 4-byte little-endian length, then msgpack array:
     [MSG_ERROR,    seq, traceback: str, exc: bytes(cloudpickle)]
     [MSG_NOTIFY,   0,   method: str, payload]
 
+Out-of-band frames (the bulk-data plane): a 5th element carries the byte
+length of a RAW binary payload appended immediately AFTER the msgpack body
+— the length prefix covers only the msgpack header, writev-style:
+    [u32 len(header)][msgpack [MSG_REQUEST_OOB,  seq, method, payload, n]][n raw bytes]
+    [u32 len(header)][msgpack [MSG_RESPONSE_OOB, seq, None,   payload, n]][n raw bytes]
+The bulk bytes are never msgpack-encoded: the sender writes the caller's
+buffer view (e.g. a plasma slice) directly after the header — zero copies
+on the send side — and the receiver lands the payload at its final
+destination in bounded pieces via a per-method sink (RpcServer.set_oob_sink)
+or a caller-provided buffer (RpcClient.call(oob_dest=...)), so a 4 MiB
+transfer chunk is never materialized as one Python bytes object. Handlers
+see the landed payload as payload["_oob"]: an int byte-count when a sink /
+oob_dest absorbed it in place, else a bytearray holding the raw bytes.
+Handlers reply out-of-band by returning an OobPayload.
+
 Every process owns a single background IO thread running one asyncio loop
 (mirroring the reference's per-process asio io_service,
 reference: src/ray/common/asio/). Synchronous front-end code posts coroutines
@@ -35,11 +50,16 @@ MSG_REQUEST = 0
 MSG_RESPONSE = 1
 MSG_ERROR = 2
 MSG_NOTIFY = 3
+MSG_REQUEST_OOB = 4
+MSG_RESPONSE_OOB = 5
 
 _LEN = struct.Struct("<I")
 # Allow frames up to 2 GiB; large data rides the plasma plane, not RPC, but
 # inline task args/returns can reach tens of MiB.
 _MAX_FRAME = (1 << 31) - 1
+# Out-of-band payloads land at their destination in pieces of this size, so
+# receiving a chunk never allocates more than this on the heap.
+_OOB_READ_PIECE = 1 << 16
 
 
 class RpcError(Exception):
@@ -64,7 +84,24 @@ def _pack(msg) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+def _pack_oob(mtype: int, seq: int, method, payload, data):
+    """Build an out-of-band frame header for `data` (any bytes-like).
+
+    Returns (header_bytes, data_view): the caller writes both back-to-back
+    (writev-style). data is NEVER copied or msgpack-encoded here — the
+    returned view aliases the caller's buffer.
+    """
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    header = msgpack.packb(
+        [mtype, seq, method, payload, mv.nbytes], use_bin_type=True
+    )
+    return _LEN.pack(len(header)) + header, mv
+
+
 async def _read_frame(reader: asyncio.StreamReader):
+    """Read one msgpack frame header. For OOB frame types the raw payload
+    (msg[4] bytes) follows on the stream and the caller MUST consume it
+    (via _read_oob_into) before reading the next frame."""
     header = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(header)
     if length > _MAX_FRAME:
@@ -73,7 +110,54 @@ async def _read_frame(reader: asyncio.StreamReader):
     return msgpack.unpackb(body, raw=False, strict_map_key=False)
 
 
+async def _read_oob_into(reader: asyncio.StreamReader, dest, nbytes: int):
+    """Land an out-of-band payload straight into `dest` (a writable
+    memoryview, e.g. a plasma buffer slice) in bounded pieces — the full
+    payload is never materialized as one heap object. dest=None drains and
+    discards (receiver had nowhere to put it but the stream must stay
+    framed)."""
+    off = 0
+    while off < nbytes:
+        piece = await reader.read(min(nbytes - off, _OOB_READ_PIECE))
+        if not piece:
+            raise asyncio.IncompleteReadError(b"", nbytes - off)
+        if dest is not None:
+            dest[off : off + len(piece)] = piece
+        off += len(piece)
+
+
+class OobPayload:
+    """Handler return marker: respond with an out-of-band frame.
+
+    `header` is the msgpack-able response payload; `data` is any bytes-like
+    (typically a plasma memoryview slice) appended raw after the header.
+    `release`, if given, is called exactly once after the frame has been
+    handed to the transport — use it to drop plasma pins.
+    """
+
+    __slots__ = ("header", "data", "_release")
+
+    def __init__(self, header, data, release=None):
+        self.header = header
+        self.data = data
+        self._release = release
+
+    def release(self):
+        cb, self._release = self._release, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+
 Handler = Callable[[Any], Awaitable[Any]]
+
+# Per-method receive sink: sink(payload, nbytes) -> None | (dest_view, done).
+# Returning a (writable memoryview, done_callback|None) lands the raw
+# payload directly at its final destination (done(ok) fires after the read
+# completes); returning None makes the server buffer it into a bytearray.
+OobSink = Callable[[Any, int], Optional[Tuple[memoryview, Optional[Callable]]]]
 
 
 class RpcServer:
@@ -87,6 +171,14 @@ class RpcServer:
         self._conns: set = set()
         self._validator = None
         self._upgrades: Dict[str, Any] = {}
+        self._oob_sinks: Dict[str, OobSink] = {}
+
+    def set_oob_sink(self, method: str, sink: OobSink):
+        """Register a landing sink for MSG_REQUEST_OOB frames of `method`:
+        the raw payload streams straight into the memoryview the sink
+        returns (e.g. a pre-created plasma buffer at the chunk's offset)
+        instead of being buffered on the heap first."""
+        self._oob_sinks[method] = sink
 
     def set_upgrade_hook(self, method: str, hook):
         """Register a connection-upgrade method: ``hook(payload) ->
@@ -141,7 +233,16 @@ class RpcServer:
                     msg = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
-                mtype, seq, method, payload = msg
+                mtype, seq, method, payload = msg[0], msg[1], msg[2], msg[3]
+                if mtype == MSG_REQUEST_OOB:
+                    try:
+                        payload = await self._land_oob(reader, method, payload, msg[4])
+                    except (asyncio.IncompleteReadError, ConnectionResetError):
+                        return
+                    asyncio.ensure_future(
+                        self._dispatch(writer, lock, seq, method, payload)
+                    )
+                    continue
                 if mtype == MSG_REQUEST and method in self._upgrades:
                     try:
                         resp, adopt = self._upgrades[method](payload)
@@ -191,6 +292,43 @@ class RpcServer:
             except Exception:
                 pass
 
+    async def _land_oob(self, reader, method, payload, nbytes: int):
+        """Consume an OOB request's raw payload. The method's sink, when
+        registered, hands back the final destination buffer (a plasma slice)
+        so the bytes never exist as one heap object; otherwise the payload
+        buffers into a bytearray. Returns the payload dict annotated with
+        "_oob" (int = landed in place via sink; bytearray = buffered)."""
+        if nbytes > _MAX_FRAME:
+            raise RpcError(f"oob payload too large: {nbytes}")
+        payload = dict(payload) if isinstance(payload, dict) else {}
+        sink = self._oob_sinks.get(method)
+        dest = done = None
+        if sink is not None:
+            try:
+                hooked = sink(payload, nbytes)
+            except Exception:
+                traceback.print_exc()
+                hooked = None
+            if hooked is not None:
+                dest, done = hooked
+        if dest is not None:
+            ok = False
+            try:
+                await _read_oob_into(reader, dest, nbytes)
+                ok = True
+            finally:
+                if done is not None:
+                    try:
+                        done(ok)
+                    except Exception:
+                        traceback.print_exc()
+            payload["_oob"] = nbytes
+        else:
+            scratch = bytearray(nbytes)
+            await _read_oob_into(reader, memoryview(scratch), nbytes)
+            payload["_oob"] = scratch
+        return payload
+
     async def _run_notify(self, handler, payload):
         try:
             await handler(payload)
@@ -205,6 +343,9 @@ class RpcServer:
             if self._validator is not None:
                 self._validator(method, payload)
             result = await handler(payload)
+            if isinstance(result, OobPayload):
+                await self._reply_oob(writer, lock, seq, result)
+                return
             out = _pack([MSG_RESPONSE, seq, None, result])
         except Exception as e:
             tb = traceback.format_exc()
@@ -220,6 +361,25 @@ class RpcServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+    async def _reply_oob(self, writer, lock, seq, result: OobPayload):
+        """Send a response whose bulk payload rides raw after the header —
+        the handler's buffer view (e.g. a plasma slice) goes straight to the
+        transport, no bytes() and no msgpack encode of the data."""
+        hdr, mv = _pack_oob(
+            MSG_RESPONSE_OOB, seq, None, result.header, result.data
+        )
+        async with lock:
+            try:
+                writer.write(hdr)
+                writer.write(mv)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                # the transport owns (a copy of) any unsent tail after
+                # write(); the handler's pin can drop now
+                result.release()
+
 
 class RpcClient:
     """Single persistent connection with multiplexed in-flight requests."""
@@ -230,6 +390,8 @@ class RpcClient:
         self._writer = None
         self._seq = 0
         self._pending: Dict[int, asyncio.Future] = {}
+        # seq -> writable memoryview an OOB response lands into directly
+        self._pending_oob_dest: Dict[int, memoryview] = {}
         self._lock: Optional[asyncio.Lock] = None
         self._connected = False
         self._read_task = None
@@ -250,11 +412,14 @@ class RpcClient:
         try:
             while True:
                 msg = await _read_frame(self._reader)
-                mtype, seq, extra, payload = msg
+                mtype, seq, extra, payload = msg[0], msg[1], msg[2], msg[3]
+                if mtype == MSG_RESPONSE_OOB:
+                    payload = await self._land_oob_response(seq, payload, msg[4])
                 fut = self._pending.pop(seq, None)
+                self._pending_oob_dest.pop(seq, None)
                 if fut is None or fut.done():
                     continue
-                if mtype == MSG_RESPONSE:
+                if mtype in (MSG_RESPONSE, MSG_RESPONSE_OOB):
                     fut.set_result(payload)
                 elif mtype == MSG_ERROR:
                     try:
@@ -271,21 +436,63 @@ class RpcClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            self._pending_oob_dest.clear()
 
-    async def call(self, method: str, payload: Any = None, timeout: float = None):
+    async def _land_oob_response(self, seq: int, payload, nbytes: int):
+        """Consume an OOB response's raw payload: straight into the buffer
+        the caller registered via call(oob_dest=...) when sizes agree (the
+        zero-copy pull path), else into a bytearray."""
+        payload = dict(payload) if isinstance(payload, dict) else {}
+        dest = self._pending_oob_dest.pop(seq, None)
+        if dest is not None and dest.nbytes == nbytes:
+            await _read_oob_into(self._reader, dest, nbytes)
+            payload["_oob"] = nbytes
+        else:
+            scratch = bytearray(nbytes)
+            await _read_oob_into(self._reader, memoryview(scratch), nbytes)
+            payload["_oob"] = scratch
+        return payload
+
+    async def call(self, method: str, payload: Any = None, timeout: float = None,
+                   oob=None, oob_dest: Optional[memoryview] = None):
+        """One request/response round-trip.
+
+        oob: bytes-like sent RAW after the request header (MSG_REQUEST_OOB)
+        — the view goes straight to the transport, never copied into a
+        packed frame. The caller must keep the underlying buffer valid
+        until call() returns (the transport copies any back-pressured tail).
+        oob_dest: writable memoryview an out-of-band RESPONSE payload lands
+        into directly; on success the response dict carries "_oob" == nbytes.
+        """
         if not self._connected:
             raise ConnectionLost(f"not connected to {self._host}:{self._port}")
         self._seq += 1
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        frame = _pack([MSG_REQUEST, seq, method, payload])
-        async with self._lock:
-            self._writer.write(frame)
-            await self._writer.drain()
-        if timeout is None:
-            return await fut
-        return await asyncio.wait_for(fut, timeout)
+        if oob_dest is not None:
+            self._pending_oob_dest[seq] = oob_dest
+        try:
+            if oob is not None:
+                hdr, mv = _pack_oob(MSG_REQUEST_OOB, seq, method, payload, oob)
+                async with self._lock:
+                    self._writer.write(hdr)
+                    self._writer.write(mv)
+                    await self._writer.drain()
+            else:
+                frame = _pack([MSG_REQUEST, seq, method, payload])
+                async with self._lock:
+                    self._writer.write(frame)
+                    await self._writer.drain()
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            if fut.cancelled() or not fut.done():
+                # timeout/cancel: a late OOB response must not land into
+                # the caller's buffer after it may have been reused
+                self._pending_oob_dest.pop(seq, None)
+                self._pending.pop(seq, None)
 
     async def notify(self, method: str, payload: Any = None):
         if not self._connected:

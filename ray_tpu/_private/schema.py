@@ -12,6 +12,12 @@ Field spec syntax:
     "field?": type           optional field
     type may be a tuple of accepted types; `object` accepts anything.
 Unknown fields are allowed (forward compatibility, like proto3 unknowns).
+
+Out-of-band payloads: methods whose bulk bytes ride raw after the frame
+header (rpc.py MSG_REQUEST_OOB / MSG_RESPONSE_OOB) see the landed payload
+as an "_oob" field injected by the transport — an int byte count when it
+streamed straight into its destination buffer, else a bytearray. Schemas
+list the legacy inline field ("data") as optional for those methods.
 """
 
 from __future__ import annotations
@@ -19,7 +25,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional, Tuple, Union
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2  # v2: out-of-band bulk frames (ReceiveChunk/FetchChunk)
 
 TypeSpec = Union[type, Tuple[type, ...]]
 
@@ -118,7 +124,10 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
                    "owner_addr?": (_addr, type(None))},
     "ReceiveBegin": {"object_id": bytes, "size": int,
                      "owner_addr?": (_addr, type(None))},
-    "ReceiveChunk": {"object_id": bytes, "offset": int, "data": bytes},
+    # chunk bytes normally arrive out-of-band ("_oob"); inline "data" is the
+    # fallback for senders without a raw buffer at hand
+    "ReceiveChunk": {"object_id": bytes, "offset": int,
+                     "data?": (bytes, bytearray)},
     "ReceiveEnd": {"object_id": bytes},
     "FetchObjectInfo": {"object_id": bytes},
     "FetchChunk": {"object_id": bytes, "offset": int, "size": int},

@@ -10,6 +10,12 @@ Wire format (RPC-inline): {"p": pickle_bytes, "b": [buffer_bytes...], "r": [ref_
 Store format (plasma): a single contiguous byte string:
     [u32 magic][u32 pickle_len][pickle][u32 nbuf]([u64 buf_len][pad to 64][buf])*
 Buffers are 64-byte aligned inside the blob so numpy/jax can map them directly.
+
+Copy discipline: `serialize()` returns the protocol-5 out-of-band buffers RAW
+(pickle.PickleBuffer views aliasing the caller's arrays). Store-bound paths
+must keep them raw and stream them with `write_blob` straight into the mapped
+destination — one copy total. Only `inline_payload()` materializes buffer
+bytes, and only because msgpack frames require real `bytes`.
 """
 
 from __future__ import annotations
@@ -139,9 +145,21 @@ def deserialize(
 # ---------------------------------------------------------------------------
 
 
+def buffers_nbytes(buffers: List) -> int:
+    """Total payload bytes across raw out-of-band buffers (no copies)."""
+    return sum(memoryview(b).nbytes for b in buffers)
+
+
+def inline_payload(p: bytes, bufs: List) -> dict:
+    """Materialize raw buffers into the msgpack-safe inline dict. This is
+    the ONLY place out-of-band buffers become `bytes`; plasma-bound values
+    must bypass it and ride write_blob instead."""
+    return {"p": p, "b": [bytes(b) for b in bufs]}
+
+
 def serialize_inline(value: Any):
     p, bufs, refs = serialize(value)
-    return {"p": p, "b": [bytes(b) for b in bufs]}, refs
+    return inline_payload(p, bufs), refs
 
 
 def deserialize_inline(msg) -> Tuple[Any, List[ObjectRef]]:
@@ -179,24 +197,40 @@ def write_blob(dest: memoryview, pickle_bytes: bytes, buffers: List) -> int:
     struct.pack_into("<I", dest, off, len(buffers))
     off += 4
     for b in buffers:
-        mv = memoryview(b).cast("B")
-        _BUFHDR.pack_into(dest, off, mv.nbytes)
+        mv = memoryview(b)
+        # cast("B") rejects empty views ("zeros in shape"); a 0-byte buffer
+        # is just its header
+        nbytes = mv.nbytes
+        _BUFHDR.pack_into(dest, off, nbytes)
         off += _BUFHDR.size
         off = _aligned(off)
-        dest[off : off + mv.nbytes] = mv
-        off += mv.nbytes
+        if nbytes:
+            dest[off : off + nbytes] = mv.cast("B")
+            off += nbytes
     return off
 
 
-def serialize_to_blob(value: Any) -> bytes:
+def serialize_to_blob(value: Any) -> bytearray:
+    """Store-format blob as a bytearray sized exactly to content — callers
+    (spill files, socket channels) write it out directly; no bytes() copy."""
     p, bufs, _refs = serialize(value)
     out = bytearray(blob_size(p, bufs))
     n = write_blob(memoryview(out), p, bufs)
-    return bytes(out[:n])
+    assert n == len(out), f"blob_size mismatch: wrote {n} of {len(out)}"
+    return out
 
 
-def read_blob(src: memoryview) -> Tuple[Any, List[ObjectRef]]:
-    """Deserialize the store format; buffers alias src (zero-copy)."""
+def read_blob(
+    src: memoryview, buffer_wrapper=None
+) -> Tuple[Any, List[ObjectRef]]:
+    """Deserialize the store format; buffers alias src (zero-copy).
+
+    ``buffer_wrapper(mv)``, when given, wraps each out-of-band buffer view
+    before it reaches the unpickler — the worker uses it to tie plasma pins
+    to buffer lifetime (worker._pinned_buffer). It is not called when the
+    blob has no out-of-band buffers, so callers can release src immediately
+    if nothing was wrapped.
+    """
     src = memoryview(src).cast("B")
     off = 0
     magic, plen = _HDR.unpack_from(src, off)
@@ -212,6 +246,7 @@ def read_blob(src: memoryview) -> Tuple[Any, List[ObjectRef]]:
         (blen,) = _BUFHDR.unpack_from(src, off)
         off += _BUFHDR.size
         off = _aligned(off)
-        buffers.append(src[off : off + blen])
+        mv = src[off : off + blen]
+        buffers.append(mv if buffer_wrapper is None else buffer_wrapper(mv))
         off += blen
     return deserialize(pickle_bytes, buffers)

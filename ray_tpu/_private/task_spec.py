@@ -43,7 +43,9 @@ def serialize_args(
       {"v": inline_payload}          — plain value (may contain nested refs)
       {"ref": [id_bytes, owner]}     — top-level ObjectRef arg (resolved by executor)
     Values larger than inline_threshold are returned in large_values as
-    (position_key, value) for the caller to put() and replace with a ref.
+    (position_key, (pickle_bytes, raw_buffers)) for the caller to store via
+    put_serialized() and replace with a ref — the value is serialized
+    exactly once and its buffers stay raw until they stream into plasma.
     """
     wire = []
     refs: List[ObjectRef] = []
@@ -53,12 +55,12 @@ def serialize_args(
         if isinstance(val, ObjectRef):
             refs.append(val)
             return {"ref": [val.object_id().binary(), list(val.owner_address or ())]}
-        payload, contained = serialization.serialize_inline(val)
-        if len(payload["p"]) + sum(len(b) for b in payload["b"]) > inline_threshold:
-            large.append((pos_key, val))
+        p, bufs, contained = serialization.serialize(val)
+        if len(p) + serialization.buffers_nbytes(bufs) > inline_threshold:
+            large.append((pos_key, (p, bufs)))
             return {"big": pos_key}
         refs.extend(contained)
-        return {"v": payload}
+        return {"v": serialization.inline_payload(p, bufs)}
 
     for i, a in enumerate(args):
         wire.append(["p", i, one(("p", i), a)])

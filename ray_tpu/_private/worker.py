@@ -61,20 +61,27 @@ def _pinned_buffer(mv: memoryview, handle: "_PinHandle"):
     releases the plasma pin so the store may reclaim the memory (matches
     the reference plasma client's buffer refcounting, plasma/client.cc).
 
-    pickle.PickleBuffer implements the buffer protocol at the C level, so
-    np.frombuffer() accepts it on every supported Python (a pure-Python
-    __buffer__ wrapper needs PEP 688, 3.12+ — on older interpreters the
-    unpickle raised, leaked the pin into the traceback, and the deferred
-    release after store teardown crashed the process). weakref.finalize
-    fires when the buffer — kept alive as the array's base — is collected.
+    The finalizer must sit on an object the deserialized value actually
+    RETAINS. numpy does NOT keep the pickle.PickleBuffer it is handed — it
+    re-exports the underlying buffer, so the deep base chain is
+    ndarray -> memoryview -> <root exporter>, and a finalizer on the
+    PickleBuffer fires as soon as unpickling returns, dropping the pin
+    while the value still aliases store memory (under store churn the
+    region gets reused and the value silently corrupts). A ctypes array
+    created with from_buffer(mv) IS the root exporter of everything built
+    on top of it — the retained memoryview's .obj — so a finalizer on it
+    fires exactly when the last aliasing view dies. pickle.PickleBuffer
+    wraps it for the unpickler (C-level buffer protocol on every supported
+    Python; a pure-Python __buffer__ wrapper needs PEP 688, 3.12+).
     """
+    import ctypes
     import pickle
     import weakref
 
-    buf = pickle.PickleBuffer(mv)
+    carr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
     handle.count += 1
-    weakref.finalize(buf, handle.dec)
-    return buf
+    weakref.finalize(carr, handle.dec)
+    return pickle.PickleBuffer(carr)
 
 
 class _PinHandle:
@@ -726,26 +733,36 @@ class CoreWorker:
         return self._driver_task_id
 
     def put(self, value: Any, _owner_hint=None) -> ObjectRef:
-        """Store a value, return an owned ref (reference: worker.py:2691 ray.put)."""
+        """Store a value, return an owned ref (reference: worker.py:2691 ray.put).
+
+        Plasma-bound values keep the RAW protocol-5 buffer views from
+        serialize() all the way into write_blob, which streams them straight
+        into the mapped shm destination — one copy total. Only the inline
+        path (small values that ride msgpack frames) materializes bytes.
+        """
         oid = self._next_put_id()
-        payload, _refs = serialization.serialize_inline(value)
-        size = len(payload["p"]) + sum(len(b) for b in payload["b"])
+        p, bufs, _refs = serialization.serialize(value)
+        size = len(p) + serialization.buffers_nbytes(bufs)
         self.refs.add_owned(oid)
         if size <= self.inline_threshold:
+            payload = serialization.inline_payload(p, bufs)
             self.io.run(self._store_inline(oid, payload))
         else:
-            nbytes = self._plasma_put_payload(oid, payload)
+            nbytes = self._plasma_put_payload(oid, p, bufs)
             self.io.run(self._register_plasma_primary(oid, nbytes))
         return ObjectRef(oid, self.address)
 
     async def _store_inline(self, oid: ObjectID, payload):
         self.memory_store.put(oid, (_INLINE, payload, None))
 
-    def _plasma_put_payload(self, oid: ObjectID, payload) -> int:
+    def _plasma_put_payload(self, oid: ObjectID, pickle_bytes: bytes,
+                            buffers: list) -> int:
         """Serialize straight into the shared-memory buffer: one copy total
         (reference plasma clients do the same via Create+mutable buffer,
-        plasma/client.cc). Returns the object's byte size."""
-        size = serialization.blob_size(payload["p"], payload["b"])
+        plasma/client.cc). `buffers` are the raw out-of-band views from
+        serialize() — never pre-materialized bytes. Returns the object's
+        byte size."""
+        size = serialization.blob_size(pickle_bytes, buffers)
         try:
             dest = self.plasma.create(oid, size)
         except FileExistsError:
@@ -780,7 +797,7 @@ class CoreWorker:
             if dest is None:
                 dest = self.plasma.create(oid, size)  # raise the real OOM
         try:
-            serialization.write_blob(dest, payload["p"], payload["b"])
+            serialization.write_blob(dest, pickle_bytes, buffers)
             dest.release()
             self.plasma.seal(oid)
         except BaseException:
@@ -990,23 +1007,12 @@ class CoreWorker:
         raise RuntimeError(f"bad resolution {res}")
 
     def _read_plasma_value(self, oid: ObjectID):
+        """Deserialize a sealed plasma object zero-copy. Parsing is
+        serialization.read_blob — one parser, one place that knows the store
+        format; the buffer_wrapper ties the plasma pin to buffer lifetime."""
         view = self.plasma.get(oid)
         if view is None:
             return ObjectLostError(f"object {oid.hex()} evicted before read")
-        import struct as _struct
-
-        src = view
-        magic, plen = _struct.unpack_from("<II", src, 0)
-        off = 8
-        pickle_bytes = bytes(src[off : off + plen])
-        off += plen
-        (nbuf,) = _struct.unpack_from("<I", src, off)
-        off += 4
-        if nbuf == 0:
-            view.release()
-            self.plasma.release(oid)
-            value, _ = serialization.deserialize(pickle_bytes, [])
-            return value
 
         def release():
             try:
@@ -1016,15 +1022,17 @@ class CoreWorker:
             self.plasma.release(oid)
 
         handle = _PinHandle(release)
-        buffers = []
-        for _ in range(nbuf):
-            (blen,) = _struct.unpack_from("<Q", src, off)
-            off += 8
-            off = (off + 63) & ~63
-            buffers.append(_pinned_buffer(src[off : off + blen], handle))
-            off += blen
-        value, _refs = serialization.deserialize(pickle_bytes, buffers)
-        del buffers
+        try:
+            value, _refs = serialization.read_blob(
+                view, buffer_wrapper=lambda mv: _pinned_buffer(mv, handle)
+            )
+        except BaseException:
+            if handle.count == 0:
+                release()
+            raise
+        if handle.count == 0:
+            # no out-of-band buffers alias the store — drop the pin now
+            release()
         return value
 
     # ------------------------------------------------------------ wait
@@ -1225,15 +1233,25 @@ class CoreWorker:
             ]}
         return runtime_env
 
+    def put_serialized(self, pickle_bytes: bytes, buffers: list) -> ObjectRef:
+        """put() for an already-serialized value: the raw buffer views go
+        straight into plasma with no re-pickle and no bytes() copy."""
+        oid = self._next_put_id()
+        self.refs.add_owned(oid)
+        nbytes = self._plasma_put_payload(oid, pickle_bytes, buffers)
+        self.io.run(self._register_plasma_primary(oid, nbytes))
+        return ObjectRef(oid, self.address)
+
     def _replace_large_args(self, wire, large) -> List[ObjectRef]:
-        """Oversized inline args are put() first and passed by ref
-        (reference: dependency_resolver.h inlining threshold)."""
+        """Oversized inline args are stored first and passed by ref
+        (reference: dependency_resolver.h inlining threshold). serialize_args
+        already serialized them — reuse its raw (pickle, buffers) pair."""
         big_refs = []
         if not large:
             return big_refs
         by_key = {}
-        for pos_key, val in large:
-            ref = self.put(val)
+        for pos_key, (p, bufs) in large:
+            ref = self.put_serialized(p, bufs)
             big_refs.append(ref)
             by_key[pos_key] = ref
         for entry in wire:
@@ -2102,10 +2120,13 @@ class CoreWorker:
         return getattr(self._ctx, "spec", None)
 
     async def put_return_to_plasma(self, oid: ObjectID, payload, spec) -> dict:
-        """Store a large task return into local plasma; owner is the caller."""
+        """Store a large task return into local plasma; owner is the caller.
+        `payload` is the executor's raw (pickle_bytes, buffers) pair — the
+        buffers stream straight into shm, never materialized as bytes."""
+        pickle_bytes, buffers = payload
         loop = asyncio.get_running_loop()
         size = await loop.run_in_executor(
-            None, self._plasma_put_payload, oid, payload
+            None, self._plasma_put_payload, oid, pickle_bytes, buffers
         )
         try:
             await self.raylet.call(

@@ -246,17 +246,17 @@ class Channel:
                         break
         else:
             self._spin(consumed, timeout, "write")
-        payload, _ = serialization.serialize_inline(value)
-        size = serialization.blob_size(payload["p"], payload["b"])
+        # raw protocol-5 buffers stream straight into the shared-memory ring
+        # (one copy total) — same discipline as the plasma put path
+        p, bufs, _refs = serialization.serialize(value)
+        size = serialization.blob_size(p, bufs)
         cap = len(self._view) - _HEADER.size
         if size > cap:
             raise ChannelFull(
                 f"serialized value is {size} bytes; channel buffer is {cap} "
                 "(pass a larger buffer_size_bytes to experimental_compile)"
             )
-        serialization.write_blob(
-            self._view[_HEADER.size:], payload["p"], payload["b"]
-        )
+        serialization.write_blob(self._view[_HEADER.size:], p, bufs)
         struct.pack_into("<QI", self._view, 8, size,
                          _FLAG_ERROR if is_error else 0)
         # publish: plain store is a fence-enough on x86/ARM under the GIL

@@ -7,6 +7,12 @@ push_manager.h). Here the owner orchestrates a binary fan-out: every round,
 every node that already holds a copy pushes to one node that doesn't, so a
 broadcast to N nodes takes ceil(log2 N) rounds and the transfer load
 spreads across holders instead of N serial pulls from the primary.
+
+Each push rides the zero-copy transfer path: the holding raylet slices its
+plasma view directly into out-of-band RPC frames (rpc.py MSG_REQUEST_OOB)
+and the receiver streams the chunks from the socket straight into its
+pre-created plasma buffer — no Python bytes materialization of the object
+anywhere in the fan-out (raylet handle_PushObject / _receive_chunk_sink).
 """
 
 from __future__ import annotations

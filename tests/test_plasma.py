@@ -40,6 +40,27 @@ def test_get_missing(store):
     assert not store.contains(_oid())
 
 
+def test_put_blob_zero_byte_and_multidim_views(store):
+    """put_blob takes any bytes-like view, including empty multi-dim
+    buffers (cast(\"B\") rejects zeros-in-shape views — regression)."""
+    oid = _oid()
+    assert store.put_blob(oid, np.zeros((0, 3), dtype=np.float64))
+    view = store.get(oid)
+    assert view is not None and view.nbytes == 0
+    view.release()
+    store.release(oid)
+
+    oid2 = _oid()
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4)
+    assert store.put_blob(oid2, memoryview(arr))
+    view = store.get(oid2)
+    assert np.array_equal(
+        np.frombuffer(view, dtype=np.float64).reshape(3, 4), arr
+    )
+    view.release()
+    store.release(oid2)
+
+
 def test_unsealed_not_gettable(store):
     oid = _oid()
     buf = store.create(oid, 100)
