@@ -99,6 +99,19 @@ _FLAGS: Dict[str, Any] = {
     "task_events_flush_period_ms": 1000,
     "task_events_max_buffer": 10_000,
     "metrics_report_period_ms": 2000,
+    # Flight recorder (_private/flight_recorder.py): per-process ring of
+    # structured runtime events, always on (RTPU_flight_recorder=0 disables,
+    # e.g. for A/B overhead measurement). Size is events per process.
+    "flight_recorder": True,
+    "flight_recorder_size": 4096,
+    # Stall watchdog (_private/watchdog.py + raylet loop): check cadence;
+    # <= 0 disables. A RUNNING/leased task older than watchdog_task_timeout_s,
+    # a submitter making no completions for that long, or train-step
+    # telemetry silent for watchdog_step_timeout_s raises a GCS incident
+    # with captured stacks + a flight-recorder snapshot.
+    "watchdog_interval_s": 10.0,
+    "watchdog_task_timeout_s": 600.0,
+    "watchdog_step_timeout_s": 300.0,
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

@@ -335,6 +335,9 @@ class DirectManager:
         if existing is not ch:  # lost a racing establish
             ch.pipe.close()
             return existing
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.record("chan.up", actor_id, f"{addr[0]}:{addr[1]}")
         t = threading.Thread(
             target=self._reader_loop, args=(ch, reader),
             name=f"rtpu-direct-{actor_id.hex()[:8]}", daemon=True)
@@ -379,6 +382,9 @@ class DirectManager:
         path (they provably never reached the worker)."""
         from ray_tpu.exceptions import ActorDiedError
 
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.record("chan.down", actor_id, f"{len(unsent_frames)} unsent")
         ch = self.channels.pop(actor_id, None)
         sub = self.core._actor_submitters.get(actor_id)
         if sub is not None:

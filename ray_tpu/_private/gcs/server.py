@@ -28,6 +28,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.gcs.persistence import GcsLog
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
@@ -198,6 +199,9 @@ class GcsServer:
         self.jobs: Dict[bytes, dict] = {}
         self.task_events: List[dict] = []
         self._worker_failures: List[dict] = []
+        # Incident table (stall watchdog + forensics): bounded append log of
+        # stall/hang reports with captured stacks and flight-recorder rings.
+        self.incidents: List[dict] = []
         # (name, sorted-label-items) -> aggregated user-metric record
         self.user_metrics: Dict[Tuple[str, tuple], dict] = {}
         self.metrics_port = 0
@@ -365,6 +369,12 @@ class GcsServer:
             self.metrics_port = 0
         self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._compaction_loop()))
+        if self.session_dir:
+            try:
+                _fr.install_exit_dump(os.path.join(
+                    self.session_dir, "logs", f"flight_gcs-{os.getpid()}.jsonl"))
+            except Exception:
+                pass
         if self.pending_actor_queue:
             asyncio.ensure_future(self._schedule_pending_actors())
         if self.pending_pg_queue:
@@ -391,6 +401,7 @@ class GcsServer:
             return
         info["state"] = "DEAD"
         info["end_time"] = time.time()
+        _fr.record("node.dead", node_id, reason[:120])
         logger.warning("node %s dead: %s", node_id.hex(), reason)
         self._persist("node", info)
         self.pubsub.publish("node", {"node_id": node_id, "state": "DEAD"})
@@ -493,7 +504,9 @@ class GcsServer:
             asyncio.ensure_future(self._schedule_pending_pgs())
 
     async def handle_GetAllNodeInfo(self, req):
-        return {"nodes": list(self.nodes.values())}
+        nodes = list(self.nodes.values())
+        limit = req.get("limit")
+        return {"nodes": nodes[:limit] if limit else nodes}
 
     async def handle_GetClusterResources(self, req):
         total: Dict[str, float] = {}
@@ -643,7 +656,9 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_GetAllJobInfo(self, req):
-        return {"jobs": list(self.jobs.values())}
+        jobs = list(self.jobs.values())
+        limit = req.get("limit")
+        return {"jobs": jobs[:limit] if limit else jobs}
 
     # ------------------------------------------------------------------ actors
 
@@ -988,6 +1003,7 @@ class GcsServer:
 
     def _publish_actor(self, actor_id: bytes, rec: dict):
         # Every state transition flows through here: persist alongside publish.
+        _fr.record("actor.state", actor_id, rec["state"])
         self._persist_actor(rec)
         msg = {
             "actor_id": actor_id,
@@ -1031,6 +1047,8 @@ class GcsServer:
                     and rec["labels"].get("WorkerId") == wid_short
                 ):
                     del self.user_metrics[key]
+        _fr.record("worker.death", req.get("worker_id") or b"",
+                   req.get("reason", "")[:120])
         self._worker_failures.append(
             {"worker_id": req.get("worker_id"), "node_id": req.get("node_id"),
              "time": time.time(), "reason": req.get("reason", "")}
@@ -1082,8 +1100,11 @@ class GcsServer:
 
     async def handle_ListActors(self, req):
         out = []
+        limit = req.get("limit") or 0
         for rec in self.actors.values():
             out.append({k: v for k, v in rec.items() if k != "creation_spec"})
+            if limit and len(out) >= limit:
+                break
         return {"actors": out}
 
     async def handle_KillActor(self, req):
@@ -1358,12 +1379,12 @@ class GcsServer:
         return {"found": True, "pg": {k: v for k, v in pg.items() if k != "ready_event"}}
 
     async def handle_ListPlacementGroups(self, req):
-        return {
-            "pgs": [
-                {k: v for k, v in pg.items() if k != "ready_event"}
-                for pg in self.placement_groups.values()
-            ]
-        }
+        pgs = [
+            {k: v for k, v in pg.items() if k != "ready_event"}
+            for pg in self.placement_groups.values()
+        ]
+        limit = req.get("limit")
+        return {"pgs": pgs[:limit] if limit else pgs}
 
     async def handle_WaitPlacementGroupReady(self, req):
         pg_id = req["pg_id"]
@@ -1428,8 +1449,87 @@ class GcsServer:
         limit = req.get("limit", 10_000)
         return {"events": out[-limit:]}
 
+    async def handle_ListTasks(self, req):
+        """Server-side fold of the task-event log into latest-state-per-task
+        rows (the state API's list_tasks shape), so clients get ``limit``
+        tasks over the wire instead of the whole event log.
+        ``detail=False`` keeps only the identity/state fields — the fast
+        path for dashboards polling task counts."""
+        job_id = req.get("job_id")
+        latest: Dict[str, dict] = {}
+        first_ts: Dict[str, float] = {}
+        for ev in self.task_events:
+            if ev.get("state") == "SPAN":
+                continue  # tracing spans ride the same sink, aren't tasks
+            if job_id is not None and ev.get("job_id") != job_id:
+                continue
+            tid = ev["task_id"]
+            first_ts.setdefault(tid, ev["ts"])
+            cur = latest.get(tid)
+            if cur is None or ev["ts"] >= cur["ts"]:
+                latest[tid] = ev
+        detail = req.get("detail", True)
+        tasks = []
+        for ev in latest.values():
+            t = {
+                "task_id": ev["task_id"],
+                "name": ev.get("name", ""),
+                "state": ev["state"],
+                "job_id": ev.get("job_id", ""),
+                "creation_time": first_ts[ev["task_id"]],
+                "last_update_time": ev["ts"],
+            }
+            if detail:
+                t["actor_id"] = ev.get("actor_id", "")
+                t["node_id"] = ev.get("node_id", "")
+                t["worker_id"] = ev.get("worker_id", "")
+                t["error_message"] = ev.get("error", "")
+            tasks.append(t)
+        tasks.sort(key=lambda t: t["creation_time"])
+        limit = req.get("limit") or 10_000
+        return {"tasks": tasks[:limit], "total": len(tasks)}
+
     async def handle_GetWorkerFailures(self, req):
         return {"failures": self._worker_failures[-req.get("limit", 1000):]}
+
+    # ------------------------------------------------------------ incidents
+
+    async def handle_ReportIncident(self, req):
+        """Stall-watchdog sink: an incident is a structured hang/stall
+        report (kind, detail, captured stacks, flight-recorder ring tail)
+        published while the problem is still live."""
+        inc = dict(req.get("incident") or {})
+        inc.setdefault("id", uuid.uuid4().hex[:16])
+        inc.setdefault("kind", "unknown")
+        inc.setdefault("time", time.time())
+        inc.setdefault("status", "open")
+        self.incidents.append(inc)
+        if len(self.incidents) > 500:
+            del self.incidents[: len(self.incidents) - 500]
+        _fr.record("incident.open", b"",
+                   f"{inc['kind']}: {str(inc.get('detail', ''))[:100]}")
+        logger.warning("incident %s [%s] from %s: %s",
+                       inc["id"], inc["kind"], inc.get("source", "?"),
+                       inc.get("detail", ""))
+        self.pubsub.publish("incident", {"id": inc["id"], "kind": inc["kind"]})
+        return {"ok": True, "id": inc["id"]}
+
+    async def handle_ListIncidents(self, req):
+        """detail=False (default) strips the bulky stacks/ring payloads —
+        the shape `ray-tpu status` and dashboards poll; `debug` passes
+        detail=True for the full forensics records."""
+        limit = req.get("limit") or 100
+        out = self.incidents[-limit:]
+        if not req.get("detail"):
+            out = [
+                {k: v for k, v in i.items() if k not in ("stacks", "ring")}
+                for i in out
+            ]
+        return {
+            "incidents": out,
+            "open": sum(1 for i in self.incidents
+                        if i.get("status") == "open"),
+        }
 
     # ------------------------------------------------------------- metrics
 
@@ -1494,6 +1594,10 @@ class GcsServer:
         count_by_state("ray_tpu_gcs_placement_groups", self.placement_groups.values())
         count_by_state("ray_tpu_gcs_jobs", self.jobs.values())
         samples.append(("ray_tpu_gcs_task_events_buffered", {}, len(self.task_events)))
+        samples.append((
+            "ray_tpu_gcs_incidents_open", {},
+            sum(1 for i in self.incidents if i.get("status") == "open"),
+        ))
         samples.append(("ray_tpu_gcs_uptime_seconds", {}, time.time() - self.start_time))
         # user metrics (util/metrics.py)
         for rec in self.user_metrics.values():
@@ -1513,6 +1617,12 @@ class GcsServer:
             else:
                 samples.append((rec["name"], rec["labels"], rec["value"]))
         return render_prometheus(samples)
+
+    async def handle_DumpFlightRecorder(self, req):
+        """The control plane's own ring — `ray-tpu debug dump` includes it
+        so a GCS-side stall (scheduling wedged, pubsub dead) is visible in
+        the same archive as the data-plane rings."""
+        return {"pid": os.getpid(), "events": _fr.dump(req.get("limit") or 0)}
 
     async def handle_Ping(self, req):
         return {

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import accelerators
+from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import runtime_env as renv
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.gcs.client import GcsAioClient
@@ -168,6 +169,15 @@ class NodeManager:
         self._bg.append(asyncio.ensure_future(self._spill_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
         self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
+        if RTPU_CONFIG.watchdog_interval_s > 0:
+            self._bg.append(asyncio.ensure_future(self._watchdog_loop()))
+        if self.session_dir:
+            try:
+                _fr.install_exit_dump(os.path.join(
+                    self.session_dir, "logs",
+                    f"flight_raylet-{os.getpid()}.jsonl"))
+            except Exception:
+                pass
         logger.info(
             "raylet %s on %s:%s resources=%s",
             self.node_id.hex()[:12], self.host, port, self.total.to_dict(),
@@ -380,8 +390,85 @@ class NodeManager:
                 self.worker_pool.reap_idle()
                 self.worker_pool.check_liveness()
                 self._check_agent()
+                _fr.flush_to_file()
             except Exception:
                 logger.exception("reaper error")
+
+    # ----------------------------------------------------- stall watchdog
+
+    async def _watchdog_loop(self):
+        """Raylet-side stall watchdog: probe every leased worker's
+        live-RUNNING registry (GetCoreWorkerStats) and fire one incident
+        per task that has been executing past
+        ``RTPU_watchdog_task_timeout_s`` — with the worker's stacks and
+        this node's flight-recorder tail captured while the hang is live.
+        Lease age alone is NOT the signal (actor workers hold their lease
+        for the actor's whole life); the executing-task age is.
+        watchdog.py is the driver-side counterpart — the raylet also sees
+        hangs whose owner/driver is itself wedged."""
+        from ray_tpu._private import watchdog as _wd
+
+        fired: set = set()  # task_ids already reported
+        interval = RTPU_CONFIG.watchdog_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                timeout = RTPU_CONFIG.watchdog_task_timeout_s
+                seen: set = set()
+                for h in list(self.worker_pool.workers.values()):
+                    if not (h.alive and h.leased and h.addr[1]):
+                        continue
+                    try:
+                        client = await self.pool.get(*h.addr)
+                        stats = await client.call(
+                            "GetCoreWorkerStats", {}, timeout=5)
+                    except Exception:
+                        continue
+                    for rt in stats.get("running_tasks", []):
+                        task_id = rt.get("task_id", b"")
+                        seen.add(task_id)
+                        if rt.get("age", 0) <= timeout or task_id in fired:
+                            continue
+                        fired.add(task_id)
+                        await self._fire_stuck_task_incident(_wd, h, rt)
+                fired &= seen  # resolved tasks leave; the set stays bounded
+            except Exception:
+                logger.exception("raylet watchdog error")
+
+    async def _fire_stuck_task_incident(self, _wd, handle, rt: dict):
+        worker_id = handle.worker_id
+        task_id = rt.get("task_id", b"")
+        _fr.record("watchdog.fire", task_id, "stuck_task")
+        stacks = []
+        try:
+            r = await self.handle_ProfileWorker(
+                {"worker_id": worker_id, "duration": 0.5})
+            stacks.append({
+                "target": f"worker:{worker_id.hex()[:12]}",
+                "folded": r.get("folded", ""),
+                "error": r.get("error", ""),
+            })
+        except Exception as e:
+            stacks.append({"target": f"worker:{worker_id.hex()[:12]}",
+                           "folded": "", "error": str(e)})
+        actor_id = self._actor_workers.get(worker_id)
+        incident = _wd.build_incident(
+            "stuck_task", "raylet",
+            f"task {rt.get('name', '?')} has been RUNNING for "
+            f"{rt.get('age', 0):.0f}s on worker {worker_id.hex()[:12]} "
+            f"(pid {handle.pid})"
+            + (f", actor {actor_id.hex()[:12]}" if actor_id else ""),
+            node_id=self.node_id.hex(),
+            worker_id=worker_id.hex(),
+            task_id=task_id.hex() if isinstance(task_id, bytes) else "",
+            task_name=rt.get("name", ""),
+            stacks=stacks,
+        )
+        try:
+            await self.gcs.call(
+                "ReportIncident", {"incident": incident}, timeout=10)
+        except Exception:
+            pass
 
     # ------------------------------------------------- per-node agent child
 
@@ -450,6 +537,14 @@ class NodeManager:
         actor_id = self._actor_workers.pop(handle.worker_id, None)
         rc = handle.returncode
         reason = self._kill_reasons.pop(handle.worker_id, None) or f"exit code {rc}"
+        _fr.record("worker.death", handle.worker_id, reason[:120])
+        # Forensics: the dead worker's flight-recorder file (incrementally
+        # appended while it lived, so it exists even after SIGKILL) — its
+        # tail rides the death report into death_cause / ActorDiedError, so
+        # "what was it doing when it died" is IN the error the caller sees.
+        tail = self._worker_flight_tail(handle.pid)
+        if tail:
+            reason = f"{reason}\nlast flight-recorder events of the worker:\n{tail}"
         await self.gcs.notify(
             "ReportWorkerDeath",
             {
@@ -459,6 +554,17 @@ class NodeManager:
                 "reason": reason,
             },
         )
+
+    def _worker_flight_tail(self, pid, limit: int = 8) -> str:
+        if not pid or not self.session_dir:
+            return ""
+        path = os.path.join(self.session_dir, "logs",
+                            f"flight_worker-{pid}.jsonl")
+        try:
+            events = _fr.read_tail_file(path, limit=limit)
+        except Exception:
+            return ""
+        return _fr.format_tail(events)[:1500]
 
     # ------------------------------------------------------ resource helpers
 
@@ -497,6 +603,7 @@ class NodeManager:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return
+        _fr.record("lease.return", lease_id, lease["worker_id"].hex()[:12])
         if lease.get("chips"):
             self._free_chips.extend(lease["chips"])
             self._free_chips.sort()
@@ -567,6 +674,7 @@ class NodeManager:
     async def handle_RegisterWorker(self, req):
         addr = (self.host, req["port"])
         token = req.get("startup_token", -1)
+        _fr.record("worker.spawn", req["worker_id"], req.get("pid", 0))
         if token >= 0:
             self.worker_pool.on_worker_registered(token, req["worker_id"], addr)
         if "actor_result" in req:
@@ -693,7 +801,10 @@ class NodeManager:
                     "grant": grant,
                     "bundle": grant["bundle"],
                     "chips": chips,
+                    "t": time.time(),
                 }
+                _fr.record("lease.grant", lease_id,
+                           handle.worker_id.hex()[:12])
                 return {
                     "granted": True,
                     "worker_addr": list(handle.addr),
@@ -897,7 +1008,9 @@ class NodeManager:
             "grant": grant,
             "bundle": grant["bundle"],
             "chips": chips,
+            "t": time.time(),
         }
+        _fr.record("lease.grant", lease_id, handle.worker_id.hex()[:12])
         self._actor_workers[handle.worker_id] = req["actor_id"]
         return {
             "granted": True,
@@ -1350,6 +1463,7 @@ class NodeManager:
                 self.plasma.delete(oid)
                 freed += nbytes
             if freed:
+                _fr.record("obj.spill", b"", f"{len(victims)} objs {freed}B")
                 logger.info(
                     "spilled %d objects / %d bytes to %s",
                     len(victims), freed, self._spill_dir,
@@ -1402,6 +1516,7 @@ class NodeManager:
             return False
         # Primary copy again: re-pin. The spill file stays so a future
         # re-spill is a free drop; FreeObjects removes it with the object.
+        _fr.record("obj.restore", oid, size)
         view = self.plasma.get(oid)
         if view is not None:
             self._pinned[oid] = view
@@ -1507,6 +1622,8 @@ class NodeManager:
                     f"prevention; task will be retried if retriable)"
                 )
                 logger.warning("%s (pid=%d)", reason, victim.pid)
+                _fr.record("worker.oom_kill", victim.worker_id,
+                           f"pid {victim.pid} frac {frac:.2f}")
                 self._kill_reasons[victim.worker_id] = reason
                 await self.worker_pool.kill_worker(victim)
             except Exception:
@@ -1762,10 +1879,13 @@ class NodeManager:
                 *(send_one(off) for off in range(0, size, chunk))
             )
             if not all(oks):
+                _fr.record("obj.push", oid, "target aborted")
                 return {"ok": False, "error": "target aborted"}
             r = await peer.call("ReceiveEnd", {"object_id": oid}, timeout=30)
+            _fr.record("obj.push", oid, "ok" if r.get("ok") else "end failed")
             return {"ok": bool(r.get("ok"))}
         except Exception as e:
+            _fr.record("rpc.error", oid, f"PushObject: {type(e).__name__}")
             return {"ok": False, "error": str(e)}
         finally:
             if view is not None:
@@ -1911,6 +2031,7 @@ class NodeManager:
                 ok = await self._restore_spilled(oid)
             else:
                 ok = await self._do_pull(oid, req.get("owner_addr"))
+            _fr.record("obj.pull", oid, "ok" if ok else "fail")
             return {"ok": ok}
         finally:
             event.set()
@@ -2115,10 +2236,37 @@ class NodeManager:
         )
         return r
 
+    async def handle_DumpFlightRecorder(self, req):
+        """Forensics fan-in: this raylet's ring plus every live local
+        worker's ring in one reply (`ray-tpu debug dump` calls this once
+        per node)."""
+        limit = req.get("limit") or 0
+        out = {
+            "node_id": self.node_id.binary(),
+            "pid": os.getpid(),
+            "events": _fr.dump(limit),
+            "workers": [],
+        }
+        if req.get("include_workers", True):
+            async def _one(h):
+                try:
+                    client = await self.pool.get(*h.addr)
+                    return await client.call(
+                        "DumpFlightRecorder", {"limit": limit}, timeout=5)
+                except Exception:
+                    return None
+
+            live = [h for h in self.worker_pool.workers.values()
+                    if h.alive and h.addr[1]]
+            replies = await asyncio.gather(*(_one(h) for h in live))
+            out["workers"] = [r for r in replies if r]
+        return out
+
     async def handle_Ping(self, req):
         return {"ok": True}
 
     async def shutdown(self):
+        _fr.flush_now()
         for t in self._bg:
             t.cancel()
         proc = getattr(self, "_agent_proc", None)

@@ -48,7 +48,7 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "ReportResources": {"node_id": bytes, "available": dict, "total": dict,
                         "pending_demands?": list, "num_leases?": int,
                         "num_workers?": int},
-    "GetAllNodeInfo": {},
+    "GetAllNodeInfo": {"limit?": int},
     "GetClusterResources": {},
     "GetInternalConfig": {},
     "GetClusterLoad": {},
@@ -68,7 +68,7 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
                "driver_sys_path?": list, "metadata?": dict},
     "GetJob": {"job_id": bytes},
     "MarkJobFinished": {"job_id": bytes},
-    "GetAllJobInfo": {},
+    "GetAllJobInfo": {"limit?": int},
     "RegisterActor": {"actor_id": bytes, "creation_spec": dict,
                       "name?": str, "namespace?": str, "max_restarts?": int,
                       "detached?": bool},
@@ -76,19 +76,24 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
                           "actor_id?": (bytes, type(None)), "reason?": str},
     "GetActorInfo": {"actor_id": bytes},
     "GetActorByName": {"name": str, "namespace?": (str, type(None))},
-    "ListActors": {},
+    "ListActors": {"limit?": int},
     "KillActor": {"actor_id": bytes, "no_restart?": bool},
     "CreatePlacementGroup": {"pg_id": bytes, "bundles": list,
                              "strategy?": str, "name?": str,
                              "job_id?": bytes,
                              "owner_worker_id?": (bytes, type(None))},
     "GetPlacementGroup": {"pg_id": bytes},
-    "ListPlacementGroups": {},
+    "ListPlacementGroups": {"limit?": int},
     "WaitPlacementGroupReady": {"pg_id": bytes, "timeout?": _num},
     "RemovePlacementGroup": {"pg_id": bytes},
     "AddTaskEvents": {"events": list},
     "GetTaskEvents": {"job_id?": (bytes, type(None)), "limit?": int},
+    "ListTasks": {"job_id?": (bytes, type(None)), "limit?": int,
+                  "detail?": bool},
     "GetWorkerFailures": {"limit?": int},
+    "ReportIncident": {"incident": dict},
+    "ListIncidents": {"limit?": int, "detail?": bool},
+    "DumpFlightRecorder": {"limit?": int},
     "ReportUserMetrics": {"records?": list},
     "GetUserMetrics": {"prefix?": str},
     "Ping": {},
@@ -136,6 +141,7 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "GetLocalWorkerInfo": {},
     "ProfileWorker": {"worker_id?": bytes, "pid?": int,
                       "duration?": _num, "hz?": _num},
+    "DumpFlightRecorder": {"limit?": int, "include_workers?": bool},
     "Ping": {},
 }
 
@@ -154,6 +160,7 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "RemoveObjectLocation": {"object_id": bytes, "node_id": bytes},
     "CancelTask": {"task_id": bytes, "force?": bool},
     "Profile": {"duration?": _num, "hz?": _num},
+    "DumpFlightRecorder": {"limit?": int},
     "KillActor": {"no_restart?": bool},
     "Exit": {},
     "Ping": {},

@@ -47,6 +47,30 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
                 continue
             if ev["state"] == "RUNNING":
                 running_ev = ev
+            elif ev["state"] in _TERMINAL and running_ev is None:
+                # Terminal event whose RUNNING was dropped (task-event ring
+                # overflow / flush loss, or a path that never emits RUNNING,
+                # e.g. async-actor tasks): without a start there is no 'X'
+                # duration to draw — emit an instant so the task is still
+                # visible in the trace instead of silently vanishing.
+                out.append(
+                    {
+                        "cat": "task",
+                        "name": f"{ev.get('name') or task_id[:8]}:{ev['state']}",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ev["ts"] * 1e6,
+                        "pid": f"node:{(ev.get('node_id') or '?')[:8]}",
+                        "tid": f"worker:{(ev.get('worker_id') or '?')[:8]}",
+                        "args": {
+                            "task_id": task_id,
+                            "job_id": ev.get("job_id", ""),
+                            "state": ev["state"],
+                            "error": ev.get("error", ""),
+                            "note": "RUNNING event missing (dropped or never emitted)",
+                        },
+                    }
+                )
             elif ev["state"] in _TERMINAL and running_ev is not None:
                 out.append(
                     {

@@ -24,6 +24,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
+from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import runtime_env as renv, serialization, task_spec as ts
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu._private.executor import Executor
@@ -51,6 +52,17 @@ MODE_WORKER = "worker"
 
 _INLINE = "inline"
 _ERR = "err"
+
+# Task-state -> flight-recorder event names, precomputed so the hot path
+# pays one dict lookup instead of a str.lower() allocation per transition.
+_FR_TASK_STATES = {
+    "PENDING": "task.pending",
+    "SUBMITTED": "task.submitted",
+    "RUNNING": "task.running",
+    "FINISHED": "task.finished",
+    "FAILED": "task.failed",
+    "RETRY": "task.retry",
+}
 
 
 def _pinned_buffer(mv: memoryview, handle: "_PinHandle"):
@@ -121,6 +133,16 @@ class TaskEventBuffer:
         # the next drain) and defer the dict build + hex conversions to
         # drain() — the flush loop runs once a second, the submit path runs
         # thousands of times a second.
+        fr_event = _FR_TASK_STATES.get(state)
+        if fr_event is not None:
+            _fr.record(fr_event, spec["task_id"], spec.get("name", ""))
+        if state == "RUNNING":
+            # live-RUNNING registry: the raylet's stall watchdog probes it
+            # via GetCoreWorkerStats to find tasks stuck in execution
+            self.core.running_tasks[spec["task_id"]] = (
+                spec.get("name", ""), time.time())
+        elif state in ("FINISHED", "FAILED"):
+            self.core.running_tasks.pop(spec["task_id"], None)
         ev = (
             spec["task_id"], spec.get("name", ""), spec.get("job_id", b""),
             spec.get("actor_id"), state, time.time(), error,
@@ -317,6 +339,14 @@ class CoreWorker:
         self.actor_id: Optional[bytes] = None
         self._actor_spec: Optional[dict] = None
         self.is_shutdown = False
+        # Monotonic completion counter for the stall watchdog: incremented
+        # on every task reply; "work pending but this hasn't moved" is the
+        # cheap no-progress signal (watchdog.py).
+        self.tasks_completed = 0
+        self._watchdog = None
+        # task_id -> (name, start wall time) while executing here
+        # (maintained by TaskEventBuffer.record on RUNNING/terminal)
+        self.running_tasks: Dict[bytes, tuple] = {}
 
         # Direct call channels (direct_channel.py): caller-side manager +
         # the actor-worker-side server behind a connection upgrade.
@@ -328,8 +358,49 @@ class CoreWorker:
             _dc.HANDSHAKE_METHOD, self._direct_upgrade)
 
         set_worker_hooks(self)
+        # Publish as the global worker BEFORE the RPC server can receive a
+        # task: the raylet may lease this worker the instant registration
+        # lands, and the pushed task's user code calls get_global_worker()
+        # — assigning the global only after __init__ returned (as every
+        # construction site does) was a startup race. Any post-connect
+        # setup below widens that window, so close it here.
+        set_global_worker(self)
         # Connect (blocking): start server, register with raylet, attach plasma.
+        try:
+            self._finish_init()
+        except BaseException:
+            set_global_worker(None)
+            set_worker_hooks(None)
+            raise
+
+    def _finish_init(self):
         self.io.run(self._connect())
+        if self.session_dir:
+            # Flight-recorder forensics file: incrementally appended by the
+            # flush loop so the tail survives SIGKILL; the raylet attaches
+            # it to this worker's death report (keyed by pid). Drivers get
+            # the file + atexit flush but keep their SIGTERM disposition.
+            try:
+                path = os.path.join(
+                    self.session_dir, "logs",
+                    f"flight_{self.mode}-{os.getpid()}.jsonl")
+                if self.mode == MODE_WORKER:
+                    _fr.install_exit_dump(path)
+                else:
+                    import atexit
+
+                    _fr.set_dump_path(path)
+                    atexit.register(_fr.flush_now)
+            except Exception:
+                pass
+        if RTPU_CONFIG.watchdog_interval_s > 0:
+            # Drivers watch their own submitted tasks; workers additionally
+            # carry the train-step-stall check (the StepRecorder lives in
+            # the train worker process, not the driver).
+            from ray_tpu._private.watchdog import StallWatchdog
+
+            self._watchdog = StallWatchdog(self)
+            self._watchdog.start()
 
     # ------------------------------------------------------------- connect
 
@@ -394,8 +465,14 @@ class CoreWorker:
         while True:
             await asyncio.sleep(2.0)
             if not self.raylet.is_connected():
+                _fr.record("worker.death", self.worker_id.binary(),
+                           "raylet connection lost")
+                _fr.flush_now()
                 os._exit(1)
             if os.getppid() == 1:
+                _fr.record("worker.death", self.worker_id.binary(),
+                           "orphaned (parent died)")
+                _fr.flush_now()
                 os._exit(1)
 
     async def _task_event_flush_loop(self):
@@ -415,6 +492,10 @@ class CoreWorker:
                 # shouldn't generate a constant wakeup storm.
                 idle_period = min(idle_period * 2, period * 8)
             self._flush_user_metrics()
+            # Keep the on-disk flight tail current (incremental append):
+            # this is what lets the raylet read a SIGKILLed worker's last
+            # events — no exit handler ever runs for SIGKILL.
+            _fr.flush_to_file()
 
     def _drain_stamped_user_metrics(self):
         """Drain ray_tpu.util.metrics records (if that module is in use),
@@ -750,6 +831,7 @@ class CoreWorker:
         else:
             nbytes = self._plasma_put_payload(oid, p, bufs)
             self.io.run(self._register_plasma_primary(oid, nbytes))
+        _fr.record("obj.put", oid.binary(), size)
         return ObjectRef(oid, self.address)
 
     async def _store_inline(self, oid: ObjectID, payload):
@@ -1283,6 +1365,8 @@ class CoreWorker:
             "retries": spec.get("max_retries", 0),
             "arg_refs": list(arg_refs),
             "return_ids": return_ids,
+            # submit wall time: the watchdog's stuck-task age source
+            "t_submit": time.time(),
         }
         self.task_events.record(spec, "PENDING")
         return out
@@ -1476,6 +1560,8 @@ class CoreWorker:
                 )
                 replies = r["replies"]
         except (ConnectionLost, OSError) as e:
+            _fr.record("rpc.error", lease["worker_id"],
+                       f"PushTask: {type(e).__name__}")
             state.all_leases.discard(lease["lease_id"])
             for s in batch:
                 await self._handle_worker_crash(s, e)
@@ -1532,6 +1618,7 @@ class CoreWorker:
 
     def _fail_task(self, spec: dict, error: Exception):
         record = self._pending_tasks.pop(spec["task_id"], None)
+        self.tasks_completed += 1  # failed is resolved, not stuck
         payload, _ = serialization.serialize_inline(error)
         for oid in ts.return_object_ids(spec):
             self.memory_store.put(oid, (_ERR, payload, None))
@@ -1567,6 +1654,7 @@ class CoreWorker:
             # re-insert an entry for a freed object that nothing removes.
             if self.refs.owns(oid):
                 self.memory_store.put(oid, (_INLINE, result["inline"], None))
+        self.tasks_completed += 1
         if record:
             self._release_task_arg_refs(record)
         if notify and self._direct is not None:
@@ -1608,6 +1696,7 @@ class CoreWorker:
             if any_plasma:
                 self._store_lineage(spec)
         self._pending_tasks.pop(spec["task_id"], None)
+        self.tasks_completed += 1
         if record:
             self._release_task_arg_refs(record)
         if self._direct is not None:
@@ -1906,6 +1995,7 @@ class CoreWorker:
 
     async def _apply_actor_state(self, sub: _ActorSubmitter, rec: dict):
         state = rec["state"]
+        _fr.record("actor.state", sub.actor_id, state)
         if state == "ALIVE" and rec.get("addr"):
             new_addr = tuple(rec["addr"])
             restarted = sub.addr is not None and new_addr != sub.addr
@@ -2205,9 +2295,14 @@ class CoreWorker:
                             "ActorTaskReplies", {"replies": batch}
                         )
                         break
-                    except Exception:
+                    except Exception as e:
+                        _fr.record("rpc.error", b"",
+                                   f"ActorTaskReplies retry {attempt}: "
+                                   f"{type(e).__name__}")
                         await asyncio.sleep(0.2 * (2 ** attempt))
                 else:
+                    _fr.record("rpc.error", b"",
+                               "ActorTaskReplies dropped (owner unreachable)")
                     self._reply_bufs.pop(addr, None)
                     return
         finally:
@@ -2296,17 +2391,31 @@ class CoreWorker:
         self.executor.cancel(req["task_id"])
 
     async def handle_KillActor(self, req):
+        _fr.record("actor.state", self.actor_id or b"", "KILLED")
+        _fr.flush_now()
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
 
     async def handle_Exit(self, req):
+        _fr.record("worker.death", self.worker_id.binary(), "Exit RPC")
+        _fr.flush_now()
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return {"ok": True}
+
+    async def handle_DumpFlightRecorder(self, req):
+        """Forensics: this process's flight-recorder ring, formatted
+        (raylet fans this out for `ray-tpu debug dump`)."""
+        return {
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+            "events": _fr.dump(req.get("limit") or 0),
+        }
 
     async def handle_Ping(self, req):
         return {"ok": True, "worker_id": self.worker_id.binary()}
 
     async def handle_GetCoreWorkerStats(self, req):
+        now = time.time()
         return {
             "worker_id": self.worker_id.binary(),
             "mode": self.mode,
@@ -2314,6 +2423,10 @@ class CoreWorker:
             "refs": self.refs.stats(),
             "memory_store_size": self.memory_store.size(),
             "pending_tasks": len(self._pending_tasks),
+            "running_tasks": [
+                {"task_id": tid, "name": name, "age": now - t0}
+                for tid, (name, t0) in list(self.running_tasks.items())
+            ],
         }
 
     # ------------------------------------------------------------- shutdown
@@ -2323,6 +2436,9 @@ class CoreWorker:
             return
         self.is_shutdown = True
         set_worker_hooks(None)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        _fr.flush_now()
         try:
             if self._direct is not None:
                 self._direct.close_all()

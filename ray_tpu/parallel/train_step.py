@@ -255,8 +255,16 @@ class TrainStep:
         # resolves its axis names there), then cached dispatch skips it.
         rec = self.telemetry
         if rec is None:
+            # Telemetry off: the flight recorder still gets a breadcrumb
+            # per dispatch (one deque append) — "did step N ever start" is
+            # exactly the question a hung mesh gets asked, and the recorder
+            # is the layer that answers it post-mortem.
+            from ray_tpu._private import flight_recorder as _fr
+
             if self._traced:
+                _fr.record("train.step", b"", "dispatch")
                 return self._step(state, batch)
+            _fr.record("train.step", b"", "trace+compile")
             with self.mesh:
                 out = self._step(state, batch)
             self._traced = True
@@ -345,6 +353,10 @@ class TrainStep:
                 self._tiled_cache = (src, tiled)
             batches = self._tiled_cache[1]
         rec = self.telemetry
+        if rec is None:
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.record("train.step", b"", f"multi_step x{num_steps}")
         t0 = time.perf_counter() if rec is not None else 0.0
         cache_before = _jit_cache_size(fn) if rec is not None else -1
         if not first:

@@ -187,6 +187,17 @@ def cmd_status(args):
     print("resources:")
     for k in sorted(res["total"]):
         print(f"  {res['available'].get(k, 0):.1f}/{res['total'][k]:.1f} {k}")
+    # Stall visibility without running `debug`: the watchdogs publish
+    # incidents to the GCS; a non-zero count here is the first hint.
+    try:
+        open_count = gcs.call("ListIncidents", {"limit": 1}).get("open", 0)
+    except Exception:
+        open_count = None
+    if open_count is None:
+        print("incidents: unavailable")
+    else:
+        print(f"incidents: {open_count} open"
+              + (" (run `ray-tpu debug incidents`)" if open_count else ""))
 
 
 def cmd_nodes(args):
@@ -274,6 +285,137 @@ def cmd_timeline(args):
     print(f"wrote {len(events)} events to {args.output}")
 
 
+def collect_debug_dump(address: str, *, ring_limit: int = 1000,
+                       stack_duration: float = 0.3) -> dict:
+    """Gather the whole cluster's forensics into {archive_name: text}.
+
+    One pass over the live cluster: state-API listings, the GCS incident
+    table (full detail), every raylet's flight-recorder ring fanned in with
+    its live workers' rings, per-node object-store stats, and a stack
+    sample of every live worker. This is the "why did step 4017 never
+    finish" bundle — callable from tests; `ray-tpu debug dump` zips it.
+    """
+    from ray_tpu._private.gcs.client import GcsClient
+    from ray_tpu.util import state
+
+    gcs = GcsClient.from_address(address)
+    files: dict = {}
+
+    def put_json(name, obj):
+        files[name] = json.dumps(obj, indent=2, default=repr)
+
+    # 1. state-API listings
+    listings = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "jobs": state.list_jobs,
+        "placement_groups": state.list_placement_groups,
+        "tasks": state.list_tasks,
+        "workers": state.list_workers,
+        "objects": state.list_objects,
+    }
+    for name, fn in listings.items():
+        try:
+            put_json(f"state/{name}.json", fn(address))
+        except Exception as e:
+            files[f"state/{name}.json"] = json.dumps({"error": str(e)})
+    # 2. incidents (full detail: stacks + rings)
+    try:
+        put_json("incidents.json",
+                 state.list_incidents(address, limit=500, detail=True))
+    except Exception as e:
+        files["incidents.json"] = json.dumps({"error": str(e)})
+    # 3. cluster config snapshot + the GCS's own ring (a control-plane
+    #    stall is as diagnosable as a data-plane one)
+    try:
+        put_json("config.json", gcs.call("GetInternalConfig", {}))
+    except Exception:
+        pass
+    try:
+        put_json("flight/gcs.json",
+                 gcs.call("DumpFlightRecorder", {"limit": ring_limit}))
+    except Exception:
+        pass
+    # 4. per-node: flight rings (raylet + its live workers), object-store
+    #    stats, and all-worker stacks
+    for n, reply in state._fanout_raylets(
+        address, "DumpFlightRecorder", timeout=30,
+        payload={"limit": ring_limit, "include_workers": True},
+    ):
+        node = n["node_id"].hex()[:12]
+        put_json(f"flight/node_{node}.json", {
+            "node_id": n["node_id"].hex(),
+            "raylet_events": reply.get("events", []),
+            "workers": [
+                {"worker_id": w.get("worker_id", b"").hex()
+                 if isinstance(w.get("worker_id"), bytes)
+                 else str(w.get("worker_id")),
+                 "pid": w.get("pid"),
+                 "events": w.get("events", [])}
+                for w in reply.get("workers", [])
+            ],
+        })
+    for n, reply in state._fanout_raylets(address, "GetNodeInfo", timeout=15):
+        node = n["node_id"].hex()[:12]
+        put_json(f"nodes/node_{node}.json", reply)
+    for n, reply in state._fanout_raylets(
+        address, "GetLocalWorkerInfo", timeout=15
+    ):
+        node = n["node_id"].hex()[:12]
+        sections = []
+        for w in reply.get("workers", []):
+            if not w.get("alive"):
+                continue
+            try:
+                from ray_tpu._private.profiling import profile_via_raylets
+
+                status, payload = profile_via_raylets(
+                    [n], worker_id=w["worker_id"],
+                    duration=stack_duration, hz=100.0,
+                )
+            except Exception as e:
+                status, payload = 500, {"error": str(e)}
+            head = (f"== worker {w['worker_id'].hex()[:12]} pid={w.get('pid')}"
+                    f" leased={w.get('leased')} ==")
+            body = (payload.get("folded", "") if status == 200
+                    else f"<error: {payload.get('error')}>")
+            sections.append(f"{head}\n{body}\n")
+        files[f"stacks/node_{node}.txt"] = "\n".join(sections) or "<no live workers>\n"
+    return files
+
+
+def cmd_debug(args):
+    """Hang/crash forensics: `debug dump` writes one archive with the
+    cluster's full debugging state; `debug incidents` lists watchdog
+    incidents."""
+    addr = _resolve_address(args)
+    if args.debug_cmd == "incidents":
+        from ray_tpu.util import state
+
+        incidents = state.list_incidents(addr, limit=args.limit)
+        if not incidents:
+            print("no incidents")
+            return
+        for i in incidents:
+            import datetime
+
+            t = datetime.datetime.fromtimestamp(i.get("time", 0))
+            print(f"{i.get('id', '?')}  {t:%H:%M:%S}  "
+                  f"{i.get('kind', '?'):<12} [{i.get('source', '?')}] "
+                  f"{i.get('detail', '')}")
+        return
+    # dump
+    import time as _time
+    import zipfile
+
+    out = args.output or f"ray_tpu_debug_{int(_time.time())}.zip"
+    files = collect_debug_dump(addr, ring_limit=args.ring_limit)
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        for name, text in sorted(files.items()):
+            z.writestr(name, text)
+    print(f"wrote {len(files)} files to {out}")
+
+
 def cmd_job(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -352,6 +494,20 @@ def main(argv=None):
     p = sub.add_parser("grafana")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_grafana)
+
+    p = sub.add_parser(
+        "debug", help="hang/crash forensics: dump archive, list incidents")
+    p.add_argument("--address", default=None)
+    dsub = p.add_subparsers(dest="debug_cmd", required=True)
+    d = dsub.add_parser("dump", help="one archive: state listings, "
+                        "all-worker stacks, per-node flight-recorder "
+                        "rings, object-store stats, incidents")
+    d.add_argument("-o", "--output", default=None)
+    d.add_argument("--ring-limit", type=int, default=1000,
+                   help="max flight-recorder events per process")
+    i = dsub.add_parser("incidents", help="list stall-watchdog incidents")
+    i.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("job")
     p.add_argument("--address", default=None)

@@ -148,8 +148,12 @@ class Replica:
             self._handled += 1
             # Replica-side end-to-end latency: queue wait + execution
             # (the handle records the caller-side view separately).
+            dt = _time.perf_counter() - t0
             metrics["requests"].inc(1, tags=tags)
-            metrics["latency"].observe(_time.perf_counter() - t0, tags=tags)
+            metrics["latency"].observe(dt, tags=tags)
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.record("serve.request", b"", f"{self._name} {dt:.4f}s")
 
     # ------------------------------------------------------------ streaming
 

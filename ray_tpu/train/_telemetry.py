@@ -143,6 +143,7 @@ class StepRecorder:
         # matters at millisecond TPU step times.
         self._publish_interval = publish_interval_s
         self._last_gauge_pub = float("-inf")
+        self._last_step_at = self._start  # stall-watchdog freshness probe
 
     # ------------------------------------------------------------ recording
 
@@ -161,8 +162,14 @@ class StepRecorder:
         duration is compile + one step — it's booked as compile time, not
         productive step time, so MFU/throughput aren't poisoned by it."""
         duration_s = max(0.0, float(duration_s))
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.record("train.step", b"",
+                   f"{steps}x {duration_s:.4f}s"
+                   + (" compile" if compile_step else ""))
         with self._lock:
             self.steps += steps
+            self._last_step_at = self._clock()
             if compile_step:
                 self.compile_s += duration_s
             else:
@@ -187,6 +194,15 @@ class StepRecorder:
     def step_timer(self):
         """Context manager measuring one step call: ``with rec.step_timer():``"""
         return _StepTimer(self)
+
+    def seconds_since_last_step(self) -> Optional[float]:
+        """Age of the newest recorded step; None before the first step.
+        The stall watchdog (_private/watchdog.py) reads this to detect a
+        training loop that recorded steps and then went silent."""
+        with self._lock:
+            if self.steps == 0:
+                return None
+            return self._clock() - self._last_step_at
 
     # ------------------------------------------------------------- derived
 

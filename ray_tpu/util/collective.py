@@ -281,12 +281,27 @@ def _sendrecv(g: _Group, right: int, left: int, out: bytes) -> bytes:
 
 def allreduce(tensor, group_name: str = "default", op: str = ReduceOp.SUM):
     """In-place ring allreduce; also returns the reduced array."""
+    from ray_tpu._private import flight_recorder as _fr
+
     g = _get(group_name)
     a = np.ascontiguousarray(tensor)
     if not a.flags.writeable:
         a = a.copy()  # zero-copy object-store views are read-only
     if g.world_size == 1:
         return a
+    # enter/exit bracket: a rank stuck INSIDE the collective (the classic
+    # mismatched-collective hang) shows an unmatched collective.enter in
+    # its flight-recorder tail — the single most valuable hang breadcrumb
+    _fr.record("collective.enter",
+               f"{group_name}:r{g.rank}".encode(), f"allreduce {a.nbytes}B")
+    try:
+        return _allreduce_ring(g, a, tensor, op)
+    finally:
+        _fr.record("collective.exit",
+                   f"{group_name}:r{g.rank}".encode(), "allreduce")
+
+
+def _allreduce_ring(g, a, tensor, op):
     w, r = g.world_size, g.rank
     right, left = (r + 1) % w, (r - 1) % w
     flat = a.reshape(-1)

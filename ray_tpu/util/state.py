@@ -32,8 +32,15 @@ def _hex(b) -> str:
     return b.hex() if isinstance(b, (bytes, bytearray)) else str(b)
 
 
+def _server_limit(filters, limit: int) -> dict:
+    """Limit applied GCS-side — but only when no client-side filters will
+    run afterwards (limiting before filtering would change results)."""
+    return {} if filters else {"limit": limit}
+
+
 def list_nodes(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
-    nodes = _gcs(address).call("GetAllNodeInfo", {})["nodes"]
+    nodes = _gcs(address).call(
+        "GetAllNodeInfo", _server_limit(filters, limit))["nodes"]
     out = [
         {
             "node_id": _hex(n["node_id"]),
@@ -54,7 +61,8 @@ def list_nodes(address: Optional[str] = None, *, filters=None, limit: int = 10_0
 
 
 def list_actors(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
-    actors = _gcs(address).call("ListActors", {})["actors"]
+    actors = _gcs(address).call(
+        "ListActors", _server_limit(filters, limit))["actors"]
     out = [
         {
             "actor_id": _hex(a["actor_id"]),
@@ -75,7 +83,8 @@ def list_actors(address: Optional[str] = None, *, filters=None, limit: int = 10_
 
 
 def list_jobs(address: Optional[str] = None, *, filters=None, limit: int = 10_000) -> List[dict]:
-    jobs = _gcs(address).call("GetAllJobInfo", {})["jobs"]
+    jobs = _gcs(address).call(
+        "GetAllJobInfo", _server_limit(filters, limit))["jobs"]
     out = [
         {
             "job_id": _hex(j["job_id"]),
@@ -93,7 +102,8 @@ def list_jobs(address: Optional[str] = None, *, filters=None, limit: int = 10_00
 def list_placement_groups(
     address: Optional[str] = None, *, filters=None, limit: int = 10_000
 ) -> List[dict]:
-    pgs = _gcs(address).call("ListPlacementGroups", {})["pgs"]
+    pgs = _gcs(address).call(
+        "ListPlacementGroups", _server_limit(filters, limit))["pgs"]
     out = [
         {
             "placement_group_id": _hex(p["pg_id"]),
@@ -115,36 +125,40 @@ def list_placement_groups(
 
 
 def list_tasks(
-    address: Optional[str] = None, *, filters=None, limit: int = 10_000
+    address: Optional[str] = None, *, filters=None, limit: int = 10_000,
+    detail: bool = True,
 ) -> List[dict]:
-    """Latest known state per task, folded from the GCS task-event log."""
-    events = _gcs(address).call("GetTaskEvents", {"limit": 100_000})["events"]
-    latest: Dict[str, dict] = {}
-    first_ts: Dict[str, float] = {}
-    for ev in events:
-        if ev.get("state") == "SPAN":
-            continue  # tracing spans share the sink but are not tasks
-        tid = ev["task_id"]
-        first_ts.setdefault(tid, ev["ts"])
-        cur = latest.get(tid)
-        if cur is None or ev["ts"] >= cur["ts"]:
-            latest[tid] = ev
+    """Latest known state per task, folded GCS-side (``ListTasks``): the
+    server folds its task-event log into one row per task and applies
+    ``limit`` before anything crosses the wire — the old path shipped the
+    whole 100k-event log and sliced client-side. ``detail=False`` is the
+    fast path for count/state polling: rows carry only identity + state
+    (no error messages / node / worker attribution)."""
+    req = {"detail": detail}
+    if not filters:
+        req["limit"] = limit
+    rows = _gcs(address).call("ListTasks", req)["tasks"]
     out = [
         {
-            "task_id": ev["task_id"],
-            "name": ev.get("name", ""),
-            "state": ev["state"],
-            "job_id": ev.get("job_id", ""),
-            "actor_id": ev.get("actor_id", "") or None,
-            "node_id": ev.get("node_id", ""),
-            "worker_id": ev.get("worker_id", ""),
-            "error_message": ev.get("error", ""),
-            "creation_time": first_ts[ev["task_id"]],
-            "last_update_time": ev["ts"],
+            "task_id": t["task_id"],
+            "name": t.get("name", ""),
+            "state": t["state"],
+            "job_id": t.get("job_id", ""),
+            "creation_time": t.get("creation_time"),
+            "last_update_time": t.get("last_update_time"),
+            **(
+                {
+                    "actor_id": t.get("actor_id", "") or None,
+                    "node_id": t.get("node_id", ""),
+                    "worker_id": t.get("worker_id", ""),
+                    "error_message": t.get("error_message", ""),
+                }
+                if detail
+                else {}
+            ),
         }
-        for ev in latest.values()
+        for t in rows
     ]
-    out.sort(key=lambda t: t["creation_time"])
     return _filtered(out, filters)[:limit]
 
 
@@ -161,7 +175,22 @@ def summarize_tasks(address: Optional[str] = None) -> dict:
     }
 
 
-def _fanout_raylets(address: Optional[str], method: str, timeout: float = 10.0):
+def list_incidents(
+    address: Optional[str] = None, *, limit: int = 100, detail: bool = False
+) -> List[dict]:
+    """Stall-watchdog incident records from the GCS (newest last).
+    ``detail=True`` includes captured stacks and flight-recorder rings."""
+    return _gcs(address).call(
+        "ListIncidents", {"limit": limit, "detail": detail}
+    )["incidents"]
+
+
+def count_open_incidents(address: Optional[str] = None) -> int:
+    return _gcs(address).call("ListIncidents", {"limit": 1}).get("open", 0)
+
+
+def _fanout_raylets(address: Optional[str], method: str, timeout: float = 10.0,
+                    payload: Optional[dict] = None):
     """Call every alive raylet concurrently; yields (node, reply) pairs."""
     import asyncio
 
@@ -177,7 +206,7 @@ def _fanout_raylets(address: Optional[str], method: str, timeout: float = 10.0):
         client = RpcClient(n["ip"], n["raylet_port"])
         try:
             await client.connect()
-            return n, await client.call(method, {}, timeout=timeout)
+            return n, await client.call(method, payload or {}, timeout=timeout)
         finally:
             await client.close()
 
