@@ -112,6 +112,33 @@ _FLAGS: Dict[str, Any] = {
     "watchdog_interval_s": 10.0,
     "watchdog_task_timeout_s": 600.0,
     "watchdog_step_timeout_s": 300.0,
+    # --- profiling plane (stability contract) ------------------------------
+    # The flag names below are a public interface (operators set them in
+    # automation, the README documents them); renaming any is a breaking
+    # change — add new flags instead.
+    #   profile_slow_step_factor     a train step slower than factor x the
+    #                                trailing-median step time triggers an
+    #                                automatic cluster profile capture +
+    #                                slow_step incident (0 disables)
+    #   profile_slow_step_cooldown_s minimum gap between slow-step captures
+    #   profile_trigger_duration_s   capture window for triggered profiles
+    #   profile_trigger_hz           sampling rate for triggered profiles
+    #   profile_on_incident          attach a cluster profile to watchdog
+    #                                incidents (stuck_task/no_progress/...)
+    #   profile_max_samples          per-process cap on timestamped samples
+    #                                kept for the timeline (folded counts
+    #                                keep aggregating past it)
+    #   device_trace_steps           arm a JAX device trace (jax.profiler)
+    #                                for N steps at the next train step;
+    #                                no-ops on CPU unless
+    #                                RTPU_device_trace_force=1
+    "profile_slow_step_factor": 3.0,
+    "profile_slow_step_cooldown_s": 600.0,
+    "profile_trigger_duration_s": 1.5,
+    "profile_trigger_hz": 99.0,
+    "profile_on_incident": True,
+    "profile_max_samples": 200_000,
+    "device_trace_steps": 0,
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

@@ -322,8 +322,13 @@ class Executor:
             if tctx:
                 from ray_tpu.util import tracing
 
+                # the spec-borne enabled bit short-circuits the KV TTL:
+                # spans in this task (and its immediate children) record
+                # even in a worker whose cached flag is stale/cold
                 tracing._mark_enabled()
-                tracing.set_context(dict(tctx))  # task-local contextvar copy
+                tracing.set_context({
+                    k: v for k, v in tctx.items() if k != "enabled"
+                })  # task-local contextvar copy
             if sem_holder._actor_sem is None:
                 sem_holder._actor_sem = asyncio.Semaphore(sem_holder._actor_max_conc)
             async with sem_holder._actor_sem:
@@ -401,8 +406,9 @@ class Executor:
         if tctx:
             from ray_tpu.util import tracing
 
-            tracing._mark_enabled()
-            tracing.set_context(dict(tctx))
+            tracing._mark_enabled()  # spec-borne enabled bit beats KV TTL
+            tracing.set_context(
+                {k: v for k, v in tctx.items() if k != "enabled"})
         try:
             return fn(*args, **kwargs)
         finally:

@@ -1440,11 +1440,19 @@ class GcsServer:
         return {"ok": True}
 
     async def handle_GetTaskEvents(self, req):
+        # Filters apply server-side so a large cluster ships N matching
+        # events, not the whole 100k-event log sliced client-side. Stored
+        # events carry job_id as hex (materialized at flush) — normalize a
+        # bytes filter to that form.
         job_id = req.get("job_id")
+        if isinstance(job_id, (bytes, bytearray)):
+            job_id = job_id.hex()
+        trace_id = req.get("trace_id")
         out = [
             e
             for e in self.task_events
-            if job_id is None or e.get("job_id") == job_id
+            if (job_id is None or e.get("job_id") == job_id)
+            and (trace_id is None or e.get("trace_id") == trace_id)
         ]
         limit = req.get("limit", 10_000)
         return {"events": out[-limit:]}
@@ -1623,6 +1631,29 @@ class GcsServer:
         so a GCS-side stall (scheduling wedged, pubsub dead) is visible in
         the same archive as the data-plane rings."""
         return {"pid": os.getpid(), "events": _fr.dump(req.get("limit") or 0)}
+
+    async def handle_StartProfile(self, req):
+        """Profiling plane: the GCS samples itself alongside the raylets —
+        a control-plane bottleneck (actor-creation storm, pubsub fan-out)
+        shows up in the same merged timeline as the data plane."""
+        from ray_tpu._private import sampling_profiler as _sp
+
+        try:
+            _sp.start_profile(
+                req.get("duration", 2.0), req.get("hz", 99.0), role="gcs")
+        except RuntimeError as e:
+            return {"error": str(e), "pid": os.getpid()}
+        return {"ok": True, "pid": os.getpid()}
+
+    async def handle_CollectProfile(self, req):
+        from ray_tpu._private import sampling_profiler as _sp
+
+        loop = asyncio.get_running_loop()
+        profile = await loop.run_in_executor(None, _sp.collect_profile)
+        if profile is None:
+            return {"error": "no profile capture in progress",
+                    "pid": os.getpid()}
+        return {"profile": profile, "pid": os.getpid()}
 
     async def handle_Ping(self, req):
         return {

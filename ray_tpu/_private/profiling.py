@@ -19,9 +19,9 @@ from typing import Dict
 
 
 def _frame_label(frame) -> str:
-    code = frame.f_code
-    fname = code.co_filename.rsplit("/", 1)[-1]
-    return f"{code.co_name} ({fname}:{frame.f_lineno})"
+    from ray_tpu._private.sampling_profiler import frame_label
+
+    return frame_label(frame)
 
 
 def sample_stacks(duration_s: float = 2.0, hz: float = 100.0,
@@ -141,3 +141,206 @@ def profile_via_raylets(nodes, *, pid=None, worker_id=None,
     if transport_err:
         return 502, {"error": f"some raylets unreachable: {transport_err}"}
     return 404, {"error": worker_err or "no such worker on any alive node"}
+
+
+# --------------------------------------------------- cluster-wide capture
+# The profiling-plane tentpole: one synchronized sampling window across
+# every process in the cluster. StartProfile fans out first (raylets fan to
+# their live workers), so all nodes sample the SAME wall-clock window; the
+# CollectProfile pass then blocks server-side until each window closes and
+# fans the per-process sample sets back in. The caller merges them with the
+# task/span timeline (_private/timeline.merged_profile_trace).
+
+
+def capture_cluster_profile(nodes, gcs=None, *, duration: float = 5.0,
+                            hz: float = 99.0, node_filter=None,
+                            include_gcs: bool = True,
+                            include_drivers: bool = True) -> dict:
+    """Returns a profile *bundle*:
+
+    {"t0", "duration", "hz",
+     "nodes": [{"node_id": hex, "profiles": [per-process result dicts]}],
+     "drivers": [per-process result dicts],
+     "gcs": per-process result dict | None,
+     "errors": ["<node hex>: <why>", ...]}
+
+    Drivers aren't in any raylet's worker pool (they register with the GCS
+    through AddJob), yet the input pipeline and submission loop — prime
+    slow-step suspects — run there, so running jobs' driver addresses get
+    the same Start/Collect pair directly.
+    """
+    import asyncio
+    import time
+
+    from ray_tpu._private.rpc import IoThread, RpcClient
+
+    duration = min(max(0.05, float(duration)), 120.0)
+    hz = min(max(1.0, float(hz)), 500.0)
+    nodes = [
+        n for n in nodes
+        if n.get("state", "ALIVE") == "ALIVE"
+        and (not node_filter or n["node_id"].hex().startswith(node_filter))
+    ]
+    bundle = {"t0": time.time(), "duration": duration, "hz": hz,
+              "nodes": [], "drivers": [], "gcs": None, "errors": []}
+
+    driver_addrs = []
+    if include_drivers and gcs is not None:
+        try:
+            for j in gcs.call("GetAllJobInfo", {}, timeout=10)["jobs"]:
+                addr = j.get("driver_addr")
+                if j.get("state") == "RUNNING" and addr and addr[1]:
+                    driver_addrs.append((addr[0], int(addr[1])))
+        except Exception:
+            pass
+
+    async def _capture_node(n):
+        client = RpcClient(n["ip"], n["raylet_port"])
+        await client.connect()
+        try:
+            await client.call(
+                "StartProfile",
+                {"duration": duration, "hz": hz, "include_workers": True},
+                timeout=15,
+            )
+            r = await client.call(
+                "CollectProfile", {}, timeout=duration + 40)
+            return {"node_id": n["node_id"].hex(),
+                    "profiles": r.get("profiles", [])}
+        finally:
+            await client.close()
+
+    async def _capture_gcs():
+        # gcs is the sync GcsClient wrapper; inside this io-thread
+        # coroutine only its .aio half is usable (io.run would deadlock)
+        if gcs is None or not include_gcs:
+            return None
+        await gcs.aio.call("StartProfile", {"duration": duration, "hz": hz},
+                           timeout=15)
+        r = await gcs.aio.call("CollectProfile", {}, timeout=duration + 40)
+        return r.get("profile")
+
+    async def _capture_driver(addr):
+        client = RpcClient(*addr)
+        await client.connect()
+        try:
+            await client.call(
+                "StartProfile", {"duration": duration, "hz": hz}, timeout=15)
+            r = await client.call("CollectProfile", {}, timeout=duration + 40)
+            return r.get("profile")
+        finally:
+            await client.close()
+
+    async def _all():
+        tasks = [_capture_node(n) for n in nodes]
+        tasks += [_capture_driver(a) for a in driver_addrs]
+        tasks.append(_capture_gcs())
+        return await asyncio.gather(*tasks, return_exceptions=True)
+
+    results = IoThread.current().run(_all(), timeout=duration + 60)
+    gcs_result = results[-1]
+    node_results = results[:len(nodes)]
+    driver_results = results[len(nodes):-1]
+    for n, r in zip(nodes, node_results):
+        if isinstance(r, BaseException):
+            bundle["errors"].append(f"{n['node_id'].hex()[:12]}: {r}")
+        else:
+            bundle["nodes"].append(r)
+    for a, r in zip(driver_addrs, driver_results):
+        if isinstance(r, BaseException):
+            bundle["errors"].append(f"driver {a[0]}:{a[1]}: {r}")
+        elif r:
+            bundle["drivers"].append(r)
+    if isinstance(gcs_result, BaseException):
+        bundle["errors"].append(f"gcs: {gcs_result}")
+    else:
+        bundle["gcs"] = gcs_result
+    return bundle
+
+
+def fold_bundle(bundle: dict) -> Dict[str, int]:
+    """Aggregate a whole bundle into one folded-stack counter; lines are
+    prefixed ``node:<id8>;<role>:<pid>;<thread>;frame;...`` so a cluster
+    flamegraph keeps per-process attribution."""
+    from ray_tpu._private.sampling_profiler import fold_samples
+
+    out: Dict[str, int] = {}
+
+    def _merge(profile, node_hex):
+        role = profile.get("role") or "proc"
+        prefix = f"node:{node_hex[:8]};{role}:{profile.get('pid', 0)};"
+        for stack, c in fold_samples(profile).items():
+            key = prefix + stack
+            out[key] = out.get(key, 0) + c
+
+    for node in bundle.get("nodes", []):
+        for p in node.get("profiles", []):
+            _merge(p, node.get("node_id", ""))
+    for p in bundle.get("drivers", []):
+        _merge(p, "driver")
+    if bundle.get("gcs"):
+        _merge(bundle["gcs"], "gcs")
+    return out
+
+
+# ------------------------------------------------------- capture registry
+# Triggered and on-demand captures register their output path in the GCS
+# KV so `ray-tpu debug dump` and the dashboard can find "the latest
+# captures" without a filesystem convention shared across hosts.
+
+_CAPTURE_NS = b"profiling"
+
+
+def register_capture(gcs, path: str, *, reason: str, extra=None) -> None:
+    import json
+    import time
+
+    rec = {"path": path, "reason": reason, "host": _hostname(),
+           "time": time.time(), **(extra or {})}
+    try:
+        gcs.kv_put(_CAPTURE_NS, f"capture:{rec['time']:.6f}".encode(),
+                   json.dumps(rec).encode())
+    except Exception:
+        pass
+
+
+def register_device_trace(gcs, path: str, *, steps: int) -> None:
+    import json
+    import time
+
+    rec = {"path": path, "steps": steps, "host": _hostname(),
+           "time": time.time()}
+    try:
+        gcs.kv_put(_CAPTURE_NS, f"device_trace:{rec['time']:.6f}".encode(),
+                   json.dumps(rec).encode())
+    except Exception:
+        pass
+
+
+def list_registered(gcs, kind: str = "capture", limit: int = 20) -> list:
+    """Newest-last registered records of one kind ('capture' or
+    'device_trace')."""
+    import json
+
+    try:
+        keys = sorted(gcs.kv_keys(_CAPTURE_NS, f"{kind}:".encode()))
+    except Exception:
+        return []
+    out = []
+    for key in keys[-limit:]:
+        try:
+            raw = gcs.kv_get(_CAPTURE_NS, key)
+            if raw:
+                out.append(json.loads(raw))
+        except Exception:
+            continue
+    return out
+
+
+def _hostname() -> str:
+    import socket
+
+    try:
+        return socket.gethostname()
+    except Exception:
+        return ""

@@ -2236,6 +2236,75 @@ class NodeManager:
         )
         return r
 
+    async def handle_StartProfile(self, req):
+        """Profiling-plane fan-out: start a synchronized capture window in
+        this raylet AND (include_workers, default True) every live local
+        worker. CollectProfile fans the sample sets back in — together the
+        pair gives the driver one RPC round per node for a cluster-wide
+        profile."""
+        from ray_tpu._private import sampling_profiler as _sp
+
+        duration = req.get("duration", 2.0)
+        hz = req.get("hz", 99.0)
+        started = 0
+        try:
+            _sp.start_profile(duration, hz, role="raylet")
+            started += 1
+        except RuntimeError:
+            pass  # a capture is already running here; collect returns it
+        errors = []
+        if req.get("include_workers", True):
+            async def _one(h):
+                try:
+                    client = await self.pool.get(*h.addr)
+                    r = await client.call(
+                        "StartProfile", {"duration": duration, "hz": hz},
+                        timeout=10)
+                    return r.get("error")
+                except Exception as e:
+                    return str(e)
+
+            live = [h for h in self.worker_pool.workers.values()
+                    if h.alive and h.addr[1]]
+            replies = await asyncio.gather(*(_one(h) for h in live))
+            for h, err in zip(live, replies):
+                if err:
+                    errors.append(f"pid {h.pid}: {err}")
+                else:
+                    started += 1
+        return {"ok": True, "started": started, "errors": errors}
+
+    async def handle_CollectProfile(self, req):
+        """Fan-in half: joins this raylet's capture (off-loop) and every
+        live worker's, returning one profile list for the node."""
+        from ray_tpu._private import sampling_profiler as _sp
+
+        loop = asyncio.get_running_loop()
+        profiles = []
+
+        async def _collect_self():
+            p = await loop.run_in_executor(None, _sp.collect_profile)
+            if p is not None:
+                return p
+            return None
+
+        async def _one(h):
+            try:
+                client = await self.pool.get(*h.addr)
+                r = await client.call("CollectProfile", {}, timeout=150)
+                return r.get("profile")
+            except Exception:
+                return None
+
+        live = [h for h in self.worker_pool.workers.values()
+                if h.alive and h.addr[1]]
+        results = await asyncio.gather(
+            _collect_self(), *(_one(h) for h in live))
+        for p in results:
+            if p:
+                profiles.append(p)
+        return {"node_id": self.node_id.binary(), "profiles": profiles}
+
     async def handle_DumpFlightRecorder(self, req):
         """Forensics fan-in: this raylet's ring plus every live local
         worker's ring in one reply (`ray-tpu debug dump` calls this once

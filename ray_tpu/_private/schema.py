@@ -87,7 +87,10 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "WaitPlacementGroupReady": {"pg_id": bytes, "timeout?": _num},
     "RemovePlacementGroup": {"pg_id": bytes},
     "AddTaskEvents": {"events": list},
-    "GetTaskEvents": {"job_id?": (bytes, type(None)), "limit?": int},
+    # job_id accepts the stored hex-string form too (events materialize ids
+    # to hex at flush); trace_id narrows to one trace's SPAN events.
+    "GetTaskEvents": {"job_id?": (bytes, str, type(None)), "limit?": int,
+                      "trace_id?": (str, type(None))},
     "ListTasks": {"job_id?": (bytes, type(None)), "limit?": int,
                   "detail?": bool},
     "GetWorkerFailures": {"limit?": int},
@@ -96,6 +99,8 @@ GCS_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "DumpFlightRecorder": {"limit?": int},
     "ReportUserMetrics": {"records?": list},
     "GetUserMetrics": {"prefix?": str},
+    "StartProfile": {"duration?": _num, "hz?": _num},
+    "CollectProfile": {},
     "Ping": {},
 }
 
@@ -141,6 +146,9 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "GetLocalWorkerInfo": {},
     "ProfileWorker": {"worker_id?": bytes, "pid?": int,
                       "duration?": _num, "hz?": _num},
+    "StartProfile": {"duration?": _num, "hz?": _num,
+                     "include_workers?": bool},
+    "CollectProfile": {},
     "DumpFlightRecorder": {"limit?": int, "include_workers?": bool},
     "Ping": {},
 }
@@ -160,6 +168,8 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "RemoveObjectLocation": {"object_id": bytes, "node_id": bytes},
     "CancelTask": {"task_id": bytes, "force?": bool},
     "Profile": {"duration?": _num, "hz?": _num},
+    "StartProfile": {"duration?": _num, "hz?": _num},
+    "CollectProfile": {},
     "DumpFlightRecorder": {"limit?": int},
     "KillActor": {"no_restart?": bool},
     "Exit": {},

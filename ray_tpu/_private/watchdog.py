@@ -65,6 +65,76 @@ def build_incident(kind: str, source: str, detail: str, *,
     }
 
 
+def capture_incident_profile(core, reason: str) -> Optional[str]:
+    """Automatic evidence capture for the profiling plane: one short
+    cluster-wide sampling window (profiling.capture_cluster_profile),
+    merged with the current task/span timeline and any registered device
+    traces into a Perfetto-loadable JSON under
+    ``<session>/logs/profiles/``. Returns the file path (registered in the
+    GCS capture registry so `ray-tpu debug dump` and the dashboard find
+    it), or None when capture failed — incident publishing must never
+    depend on it."""
+    import json
+
+    from ray_tpu._private import profiling
+    from ray_tpu._private import timeline as _tl
+
+    try:
+        nodes = core.gcs.get_all_node_info()
+        bundle = profiling.capture_cluster_profile(
+            nodes, core.gcs,
+            duration=RTPU_CONFIG.profile_trigger_duration_s,
+            hz=RTPU_CONFIG.profile_trigger_hz,
+        )
+        try:
+            task_events = core.gcs.call(
+                "GetTaskEvents", {"limit": 20_000}, timeout=10)["events"]
+        except Exception:
+            task_events = []
+        device = profiling.list_registered(core.gcs, "device_trace")
+        trace = _tl.merged_profile_trace(bundle, task_events, device)
+        base = core.session_dir
+        if not base:
+            try:
+                base = core.gcs.call(
+                    "GetInternalConfig", {}, timeout=5).get("session_dir", "")
+            except Exception:
+                base = ""
+        if base:
+            out_dir = os.path.join(base, "logs", "profiles")
+        else:
+            import tempfile
+
+            out_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_profiles")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"profile_{reason}_{int(time.time() * 1000)}.json")
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        profiling.register_capture(core.gcs, path, reason=reason)
+        _record_capture_metric(reason)
+        return path
+    except Exception:
+        return None
+
+
+_capture_counter = None
+
+
+def _record_capture_metric(reason: str):
+    global _capture_counter
+    try:
+        from ray_tpu.util.metrics import Counter
+
+        if _capture_counter is None:
+            _capture_counter = Counter(
+                "ray_tpu_profile_captures_total",
+                "automatic cluster-profile captures", tag_keys=("trigger",))
+        _capture_counter.inc(tags={"trigger": reason})
+    except Exception:
+        pass
+
+
 class StallWatchdog:
     """Per-CoreWorker watchdog thread (drivers AND workers: the driver
     watches its submitted tasks; a train worker carries the step-stall
@@ -76,6 +146,9 @@ class StallWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._fired: set = set()  # dedupe keys, one incident per subject
         self._progress = (0, time.time())  # (tasks_completed, t of change)
+        # Slow steps recur by nature, so they rate-limit on a cooldown
+        # instead of the once-per-subject set.
+        self._last_slow_capture = 0.0
 
     def start(self):
         self._thread = threading.Thread(
@@ -147,6 +220,17 @@ class StallWatchdog:
                     f"after {rec.steps} recorded steps",
                 )
 
+        # 4. a train step blew past the trailing median: capture a cluster
+        #    profile while the cause (input stall, straggler host, noisy
+        #    neighbor) is still warm and publish it as a slow_step incident
+        if rec is not None and hasattr(rec, "pop_slow_step"):
+            slow = rec.pop_slow_step()
+            cooldown = RTPU_CONFIG.profile_slow_step_cooldown_s
+            if (slow is not None
+                    and now - self._last_slow_capture >= cooldown):
+                self._last_slow_capture = now
+                self._fire_slow_step(slow)
+
     # -------------------------------------------------------------- firing
 
     def _fire_stuck_task(self, task_id: bytes, rec: dict, now: float):
@@ -173,6 +257,23 @@ class StallWatchdog:
             worker_id=self.core.worker_id.hex(),
             stacks=stacks,
         ), b"")
+
+    def _fire_slow_step(self, slow: dict):
+        incident = build_incident(
+            "slow_step", self.core.mode,
+            f"train step {int(slow.get('step', 0))} took "
+            f"{slow.get('duration_s', 0):.3f}s — "
+            f"{slow.get('ratio', 0):.1f}x the trailing median "
+            f"({slow.get('median_s', 0):.3f}s)",
+            node_id=self.core.node_id.hex() if self.core.node_id else "",
+            worker_id=self.core.worker_id.hex(),
+        )
+        incident["slow_step"] = {
+            k: float(v) for k, v in slow.items()}
+        path = capture_incident_profile(self.core, "slow_step")
+        if path:
+            incident["profile_path"] = path
+        self._publish(incident, b"")
 
     def _gather_stacks(self, exec_worker_id) -> list:
         stacks = []
@@ -207,6 +308,14 @@ class StallWatchdog:
 
     def _publish(self, incident: dict, subject: bytes):
         _fr.record("watchdog.fire", subject, incident["kind"])
+        if ("profile_path" not in incident
+                and RTPU_CONFIG.profile_on_incident):
+            # Evidence while the hang is live: a short cluster profile
+            # rides every incident this watchdog opens
+            # (RTPU_profile_on_incident=0 disables).
+            path = capture_incident_profile(self.core, incident["kind"])
+            if path:
+                incident["profile_path"] = path
         try:
             self.core.gcs.call(
                 "ReportIncident", {"incident": incident}, timeout=10)

@@ -2387,6 +2387,36 @@ class CoreWorker:
         return {"folded": profiling.folded_text(counts),
                 "samples": sum(counts.values()), "pid": os.getpid()}
 
+    async def handle_StartProfile(self, req):
+        """Profiling plane: kick off a timed background capture of this
+        process (timestamped samples, _private/sampling_profiler.py). The
+        raylet fans this out so a whole node — then the whole cluster —
+        samples one synchronized window; CollectProfile fans the results
+        back in."""
+        from ray_tpu._private import sampling_profiler as _sp
+
+        try:
+            _sp.start_profile(
+                req.get("duration", 2.0), req.get("hz", 99.0),
+                role=self.mode)
+        except RuntimeError as e:
+            return {"error": str(e), "pid": os.getpid()}
+        return {"ok": True, "pid": os.getpid()}
+
+    async def handle_CollectProfile(self, req):
+        """Blocks until the capture window started by StartProfile closes,
+        then returns the sample set (off-loop: the join must not stall the
+        worker's RPC loop)."""
+        from ray_tpu._private import sampling_profiler as _sp
+
+        loop = asyncio.get_running_loop()
+        profile = await loop.run_in_executor(None, _sp.collect_profile)
+        if profile is None:
+            return {"error": "no profile capture in progress",
+                    "pid": os.getpid()}
+        profile["worker_id"] = self.worker_id.hex()
+        return {"profile": profile, "pid": os.getpid()}
+
     async def handle_CancelTask(self, req):
         self.executor.cancel(req["task_id"])
 

@@ -255,11 +255,13 @@ def available_resources() -> Dict[str, float]:
     return get_global_worker().gcs.get_cluster_resources()["available"]
 
 
-def timeline(filename: Optional[str] = None):
+def timeline(filename: Optional[str] = None, *,
+             job_id: Optional[str] = None, trace_id: Optional[str] = None):
     """Chrome-tracing dump of task events (reference: _private/state.py:944
-    chrome_tracing_dump; open in chrome://tracing or ui.perfetto.dev)."""
+    chrome_tracing_dump; open in chrome://tracing or ui.perfetto.dev).
+    ``job_id`` (hex) / ``trace_id`` filter server-side."""
     from ray_tpu._private.timeline import timeline as _timeline
 
     get_global_worker()  # raise early if not initialized
-    result = _timeline(filename)
+    result = _timeline(filename, job_id=job_id, trace_id=trace_id)
     return filename if filename else result

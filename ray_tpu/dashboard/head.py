@@ -10,6 +10,9 @@ so the endpoint is human-checkable.
 Routes:
   GET  /api/cluster                cluster resource summary
   GET  /api/nodes|actors|tasks|objects|workers|placement_groups|jobs
+  GET  /api/profile                cluster-wide CPU capture (merged trace;
+                                   ?format=flame folded, ?latest=1 registry,
+                                   ?pid=/?worker_id= one-worker folded)
   GET  /api/jobs/                  submitted jobs (job_submission API)
   POST /api/jobs/                  submit {entrypoint, runtime_env?, ...}
   GET  /api/jobs/<id>              job info
@@ -138,21 +141,29 @@ class DashboardHead:
         return 404, {"error": f"no route {path}"}
 
     def _profile_api(self, query):
-        """GET /api/profile?pid=N[&node_id=hex][&duration=2][&hz=100]:
-        on-demand stack sampling of a worker process, flamegraph-folded
-        output (reference: dashboard reporter profile_manager.py:78 —
-        py-spy-shaped capability without the binary dependency)."""
+        """GET /api/profile: the profiling plane over HTTP.
+
+        With ``?pid=N`` / ``?worker_id=hex``: on-demand stack sampling of
+        one worker process, flamegraph-folded output (reference: dashboard
+        reporter profile_manager.py:78 — py-spy-shaped capability without
+        the binary dependency). Optional ``node_id``/``duration``/``hz``.
+
+        Without either: a cluster-wide synchronized capture
+        (StartProfile/CollectProfile fan-out) returned as one
+        Perfetto-loadable merged trace — ``?format=flame`` returns the
+        aggregated folded stacks instead; ``?latest=1`` lists registered
+        captures without sampling anything."""
         pid = query.get("pid")
         worker_id = query.get("worker_id")
-        if not pid and not worker_id:
-            return 400, {"error": "pass ?pid= or ?worker_id="}
         try:
             duration = float(query.get("duration", 2.0) or 2.0)
-            hz = float(query.get("hz", 100.0) or 100.0)
+            hz = float(query.get("hz", 99.0) or 99.0)
             pid = int(pid) if pid else None
             wid = bytes.fromhex(worker_id) if worker_id else None
         except ValueError as e:
             return 400, {"error": f"bad query value: {e}"}
+        if not pid and not wid:
+            return self._cluster_profile_api(query, duration, hz)
         # Prefer the node's agent (keeps sampling fan-out off the raylet
         # loop); fall back to the raylet proxy when no agent is registered.
         node_id = query.get("node_id")
@@ -176,6 +187,40 @@ class DashboardHead:
             pid=pid, worker_id=wid, node_filter=query.get("node_id"),
             duration=duration, hz=hz,
         )
+
+    def _cluster_profile_api(self, query, duration, hz):
+        from ray_tpu._private import profiling
+
+        gcs = self._gcs_client()
+        if query.get("latest"):
+            return 200, {
+                "captures": profiling.list_registered(gcs, "capture"),
+                "device_traces": profiling.list_registered(
+                    gcs, "device_trace"),
+            }
+        # Bound what one HTTP call can cost the cluster.
+        duration = min(duration, 30.0)
+        bundle = profiling.capture_cluster_profile(
+            gcs.get_all_node_info(), gcs,
+            duration=duration, hz=hz, node_filter=query.get("node_id"),
+        )
+        if query.get("format") == "flame":
+            folded = profiling.fold_bundle(bundle)
+            text = "\n".join(
+                f"{s} {c}"
+                for s, c in sorted(folded.items(), key=lambda kv: -kv[1]))
+            return 200, {"folded": text,
+                         "samples": sum(folded.values()),
+                         "errors": bundle["errors"]}
+        from ray_tpu._private.timeline import merged_profile_trace
+
+        try:
+            task_events = gcs.call(
+                "GetTaskEvents", {"limit": 100_000})["events"]
+        except Exception:
+            task_events = []
+        device = profiling.list_registered(gcs, "device_trace")
+        return 200, merged_profile_trace(bundle, task_events, device)
 
     # ------------------------------------------------- workload telemetry
 

@@ -269,6 +269,9 @@ class TrainStep:
                 out = self._step(state, batch)
             self._traced = True
             return out
+        # Device-trace hook (train/_telemetry.DeviceTraceController): inert
+        # two-attribute check unless a jax.profiler window was armed.
+        rec.device_trace.on_step_begin()
         t0 = time.perf_counter()
         was_traced = self._traced
         cache_before = _jit_cache_size(self._step)
@@ -300,6 +303,7 @@ class TrainStep:
             examples=None if compiled else examples,
             compile_step=compiled,
         )
+        rec.device_trace.on_step_end(out)
         return out
 
     def multi_step(self, state, batches, num_steps: int):
@@ -357,6 +361,8 @@ class TrainStep:
             from ray_tpu._private import flight_recorder as _fr
 
             _fr.record("train.step", b"", f"multi_step x{num_steps}")
+        if rec is not None:
+            rec.device_trace.on_step_begin()
         t0 = time.perf_counter() if rec is not None else 0.0
         cache_before = _jit_cache_size(fn) if rec is not None else -1
         if not first:
@@ -386,4 +392,5 @@ class TrainStep:
                 time.perf_counter() - t0, steps=num_steps,
                 tokens=tokens, examples=examples, compile_step=compiled,
             )
+            rec.device_trace.on_step_end(out)
         return out

@@ -12,9 +12,12 @@ Commands:
   nodes    [--address]
   actors   [--address]
   memory   [--address]           object-store usage per node
-  timeline [--address] -o FILE   Chrome-trace dump
-  profile  [--address] --pid N [--duration S] [-o FILE]
-                                 flamegraph-folded stack sample of a worker
+  timeline [--address] [--job HEX] [--trace-id ID] -o FILE
+                                 Chrome-trace dump (filters server-side)
+  profile  [--address] [--duration S] [--hz N] [--node HEX] [-o FILE]
+                                 cluster-wide CPU capture merged with the
+                                 task timeline (Perfetto JSON); --flame for
+                                 folded stacks, --pid N for one worker
   grafana  [-o FILE]             generated Grafana dashboard JSON
   job submit  --address ADDR -- ENTRYPOINT...
   job status  --address ADDR SUBMISSION_ID
@@ -236,13 +239,25 @@ def cmd_memory(args):
 
 
 def cmd_profile(args):
-    """On-demand stack sampling of a worker by pid (reference: `ray`'s
-    dashboard py-spy integration). Shares the dashboard endpoint's
-    fan-out — same cross-node pid-ambiguity guard and error semantics."""
+    """Profiling plane, two modes:
+
+    With ``--pid``: on-demand stack sampling of ONE worker (reference:
+    `ray`'s dashboard py-spy integration), flamegraph-folded output —
+    shares the dashboard endpoint's fan-out, ambiguity guard and errors.
+
+    Without ``--pid``: a CLUSTER-WIDE capture — every raylet, its live
+    workers and the GCS sample one synchronized window
+    (StartProfile/CollectProfile fan-out) and the samples merge with
+    task/span events and registered device traces into one
+    Perfetto-loadable JSON (``-o``, default profile.json). ``--flame``
+    emits the aggregated folded stacks instead (flamegraph.pl/speedscope
+    input)."""
     from ray_tpu._private.gcs.client import GcsClient
     from ray_tpu._private.profiling import profile_via_raylets
 
     gcs = GcsClient.from_address(_resolve_address(args))
+    if args.pid is None:
+        return _cluster_profile(args, gcs)
     status, payload = profile_via_raylets(
         gcs.get_all_node_info(), pid=args.pid,
         node_filter=args.node_id, duration=args.duration, hz=args.hz,
@@ -257,6 +272,52 @@ def cmd_profile(args):
         print(f"wrote {payload['samples']} samples to {args.output}")
     else:
         print(out)
+
+
+def _cluster_profile(args, gcs):
+    from ray_tpu._private import profiling
+    from ray_tpu._private.timeline import merged_profile_trace
+
+    bundle = profiling.capture_cluster_profile(
+        gcs.get_all_node_info(), gcs,
+        duration=args.duration, hz=args.hz, node_filter=args.node_id,
+    )
+    all_profiles = (
+        [p for n in bundle["nodes"] for p in n["profiles"]]
+        + bundle.get("drivers", [])
+        + ([bundle["gcs"]] if bundle.get("gcs") else [])
+    )
+    n_profiles = len(all_profiles)
+    n_samples = sum(len(p["samples"]) for p in all_profiles)
+    for err in bundle["errors"]:
+        print(f"warning: {err}", file=sys.stderr)
+    if args.flame:
+        folded = profiling.fold_bundle(bundle)
+        text = "\n".join(
+            f"{stack} {c}"
+            for stack, c in sorted(folded.items(), key=lambda kv: -kv[1])
+        )
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+            print(f"wrote {n_samples} samples from {n_profiles} processes "
+                  f"to {args.output}")
+        else:
+            print(text)
+        return
+    try:
+        task_events = gcs.call("GetTaskEvents", {"limit": 100_000})["events"]
+    except Exception:
+        task_events = []
+    device = profiling.list_registered(gcs, "device_trace")
+    trace = merged_profile_trace(bundle, task_events, device)
+    out = args.output or "profile.json"
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    profiling.register_capture(gcs, os.path.abspath(out), reason="cli")
+    print(f"wrote {len(trace['traceEvents'])} events "
+          f"({n_samples} CPU samples from {n_profiles} processes) to {out}")
+    print("open in https://ui.perfetto.dev or chrome://tracing")
 
 
 def cmd_grafana(args):
@@ -277,9 +338,12 @@ def cmd_timeline(args):
     from ray_tpu._private.timeline import chrome_trace_events
 
     gcs = GcsClient.from_address(_resolve_address(args))
-    events = chrome_trace_events(
-        gcs.call("GetTaskEvents", {"limit": 100_000})["events"]
-    )
+    req = {"limit": 100_000}
+    if getattr(args, "job", None):
+        req["job_id"] = args.job
+    if getattr(args, "trace_id", None):
+        req["trace_id"] = args.trace_id
+    events = chrome_trace_events(gcs.call("GetTaskEvents", req)["events"])
     with open(args.output, "w") as f:
         json.dump(events, f)
     print(f"wrote {len(events)} events to {args.output}")
@@ -325,7 +389,29 @@ def collect_debug_dump(address: str, *, ring_limit: int = 1000,
                  state.list_incidents(address, limit=500, detail=True))
     except Exception as e:
         files["incidents.json"] = json.dumps({"error": str(e)})
-    # 3. cluster config snapshot + the GCS's own ring (a control-plane
+    # 3. profiling plane: the capture registry (triggered + on-demand
+    #    cluster profiles, device-trace dirs) and the latest capture files
+    #    themselves when they're readable from this host
+    try:
+        from ray_tpu._private import profiling as _prof
+
+        caps = _prof.list_registered(gcs, "capture")
+        put_json("profiles/index.json", {
+            "captures": caps,
+            "device_traces": _prof.list_registered(gcs, "device_trace"),
+        })
+        for rec in caps[-3:]:
+            path = rec.get("path", "")
+            try:
+                if (path and os.path.isfile(path)
+                        and os.path.getsize(path) <= 64 * 1024 * 1024):
+                    with open(path) as f:
+                        files[f"profiles/{os.path.basename(path)}"] = f.read()
+            except OSError:
+                continue
+    except Exception as e:
+        files["profiles/index.json"] = json.dumps({"error": str(e)})
+    # 4. cluster config snapshot + the GCS's own ring (a control-plane
     #    stall is as diagnosable as a data-plane one)
     try:
         put_json("config.json", gcs.call("GetInternalConfig", {}))
@@ -336,7 +422,7 @@ def collect_debug_dump(address: str, *, ring_limit: int = 1000,
                  gcs.call("DumpFlightRecorder", {"limit": ring_limit}))
     except Exception:
         pass
-    # 4. per-node: flight rings (raylet + its live workers), object-store
+    # 5. per-node: flight rings (raylet + its live workers), object-store
     #    stats, and all-worker stacks
     for n, reply in state._fanout_raylets(
         address, "DumpFlightRecorder", timeout=30,
@@ -479,15 +565,29 @@ def main(argv=None):
 
     p = sub.add_parser("timeline")
     p.add_argument("--address", default=None)
+    p.add_argument("--job", default=None,
+                   help="only this job's events (hex id, server-side)")
+    p.add_argument("--trace-id", dest="trace_id", default=None,
+                   help="only this trace's spans (server-side)")
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
 
-    p = sub.add_parser("profile")
+    p = sub.add_parser(
+        "profile",
+        help="cluster-wide CPU profile merged with the task timeline; "
+             "--pid samples one worker")
     p.add_argument("--address", default=None)
-    p.add_argument("--pid", type=int, required=True)
-    p.add_argument("--node-id", dest="node_id", default=None)
+    p.add_argument("--pid", type=int, default=None,
+                   help="sample ONE worker (folded output); omit for a "
+                        "cluster-wide capture")
+    p.add_argument("--node", "--node-id", dest="node_id", default=None,
+                   help="restrict to nodes whose id starts with this hex "
+                        "prefix")
     p.add_argument("--duration", type=float, default=2.0)
-    p.add_argument("--hz", type=float, default=100.0)
+    p.add_argument("--hz", type=float, default=99.0)
+    p.add_argument("--flame", action="store_true",
+                   help="folded-stack (flamegraph/speedscope) output "
+                        "instead of the merged Perfetto trace")
     p.add_argument("-o", "--output", default=None)
     p.set_defaults(fn=cmd_profile)
 

@@ -30,9 +30,11 @@ Metric names are a stability contract — see ``ray_tpu/util/metrics.py``.
 from __future__ import annotations
 
 import os
+import statistics
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, Optional
 
 # Peak dense matmul throughput per chip (bf16 FLOP/s), keyed by substrings
@@ -144,6 +146,23 @@ class StepRecorder:
         self._publish_interval = publish_interval_s
         self._last_gauge_pub = float("-inf")
         self._last_step_at = self._start  # stall-watchdog freshness probe
+        # Slow-step detection for the profiling plane: per-step durations
+        # feed a trailing window; a step slower than
+        # RTPU_profile_slow_step_factor x the window median is flagged and
+        # picked up by the stall watchdog (pop_slow_step), which captures a
+        # cluster profile while the cause is likely still warm. The factor
+        # is snapshotted once — each RTPU_CONFIG read is an os.environ
+        # probe, too slow for a per-step path.
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        self._slow_factor = RTPU_CONFIG.profile_slow_step_factor
+        self._recent_steps: deque = deque(maxlen=32)
+        self._median_cache: Optional[float] = None  # refreshed every 8 steps
+        self._steps_since_median = 0
+        self._slow_step: Optional[Dict[str, float]] = None
+        # Device-trace window (jax.profiler) armed via request_device_trace
+        # or RTPU_device_trace_steps; driven by TrainStep around dispatch.
+        self.device_trace = DeviceTraceController()
 
     # ------------------------------------------------------------ recording
 
@@ -175,7 +194,28 @@ class StepRecorder:
             else:
                 self.productive_s += duration_s
                 self.productive_steps += steps
-                self._last_step_s = duration_s / max(steps, 1)
+                per_step = duration_s / max(steps, 1)
+                self._last_step_s = per_step
+                # flag BEFORE appending: the outlier must not dilute the
+                # median it is judged against. The median itself refreshes
+                # every 8 steps — a per-step O(1) compare, not a per-step
+                # sort (this path runs at millisecond step times).
+                med = self._median_cache
+                if (self._slow_factor > 0 and med is not None and med > 0
+                        and per_step > self._slow_factor * med):
+                    self._slow_step = {
+                        "step": self.steps,
+                        "duration_s": per_step,
+                        "median_s": med,
+                        "ratio": per_step / med,
+                        "time": self._wall(),
+                    }
+                self._recent_steps.append(per_step)
+                self._steps_since_median += 1
+                if (self._steps_since_median >= 8
+                        and len(self._recent_steps) >= 8):
+                    self._median_cache = statistics.median(self._recent_steps)
+                    self._steps_since_median = 0
             if tokens:
                 self.tokens += tokens
             if examples:
@@ -203,6 +243,15 @@ class StepRecorder:
             if self.steps == 0:
                 return None
             return self._clock() - self._last_step_at
+
+    def pop_slow_step(self) -> Optional[Dict[str, float]]:
+        """Latest pending slow-step flag (step slower than
+        profile_slow_step_factor x trailing median), cleared on read. The
+        watchdog polls this and answers with an automatic cluster-profile
+        capture + ``slow_step`` incident."""
+        with self._lock:
+            out, self._slow_step = self._slow_step, None
+            return out
 
     # ------------------------------------------------------------- derived
 
@@ -431,6 +480,144 @@ class _StepTimer:
                 compile_step=self.compile_step, start_wall=self._w0,
             )
         return False
+
+
+# ------------------------------------------------------ device-trace window
+# The host-side sampler (profiling plane) sees Python; XLA device time is a
+# black box to it. This controller arms ``jax.profiler.trace`` around a
+# window of N train steps — TrainStep calls on_step_begin/on_step_end around
+# each dispatch — and registers the produced trace directory with the GCS so
+# the merged Perfetto timeline links to it (open with `tensorboard
+# --logdir` / xprof for the device view).
+
+
+class DeviceTraceController:
+    """Arm-once device-trace windows; inert (two attribute reads per step)
+    unless armed via ``request()`` or ``RTPU_device_trace_steps=N``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._dir: Optional[str] = None
+        self._count = 0
+        self._target = 0
+        self._requested_dir: Optional[str] = None
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        self._armed = max(0, int(RTPU_CONFIG.device_trace_steps))
+
+    # ------------------------------------------------------------- control
+
+    def request(self, num_steps: int = 3,
+                trace_dir: Optional[str] = None) -> None:
+        """Arm a trace window around the next ``num_steps`` step calls."""
+        with self._lock:
+            if not self._active:
+                self._armed = max(1, int(num_steps))
+                self._requested_dir = trace_dir
+
+    @staticmethod
+    def supported() -> bool:
+        """Device tracing is a no-op on CPU or without a usable jax
+        profiler — RTPU_device_trace_force=1 overrides (tests, host-trace
+        debugging)."""
+        if os.environ.get("RTPU_device_trace_force") == "1":
+            return True
+        try:
+            import jax
+
+            if not hasattr(jax.profiler, "start_trace"):
+                return False
+            return any(d.platform != "cpu" for d in jax.local_devices())
+        except Exception:
+            return False
+
+    def _trace_dir(self) -> str:
+        if self._requested_dir:
+            return self._requested_dir
+        base = ""
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker
+            if w is not None and w.session_dir:
+                base = os.path.join(w.session_dir, "logs", "device_traces")
+        except Exception:
+            pass
+        if not base:
+            import tempfile
+
+            base = os.path.join(tempfile.gettempdir(), "ray_tpu_device_traces")
+        return os.path.join(base, f"trace_{int(time.time() * 1000)}")
+
+    # ----------------------------------------------------------- per step
+
+    def on_step_begin(self) -> None:
+        if not self._armed or self._active:
+            return
+        with self._lock:
+            if not self._armed or self._active:
+                return
+            target, self._armed = self._armed, 0
+            if not self.supported():
+                return  # silently disarm: no-op on CPU/absent profiler
+            try:
+                import jax
+
+                path = self._trace_dir()
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
+            except Exception:
+                return
+            self._active = True
+            self._dir = path
+            self._target = target
+            self._count = 0
+
+    def on_step_end(self, out=None) -> None:
+        if not self._active:
+            return
+        with self._lock:
+            if not self._active:
+                return
+            self._count += 1
+            if self._count < self._target:
+                return
+            self._active = False
+            path, self._dir = self._dir, None
+            try:
+                import jax
+
+                if out is not None:
+                    # drain the async dispatch backlog so the window holds
+                    # the whole last step, not its launch
+                    jax.block_until_ready(out)
+                jax.profiler.stop_trace()
+            except Exception:
+                return
+        self._register(path)
+
+    def _register(self, path: str) -> None:
+        try:
+            from ray_tpu._private import profiling, worker as worker_mod
+
+            w = worker_mod.global_worker
+            if w is not None:
+                profiling.register_device_trace(
+                    w.gcs, path, steps=self._target)
+        except Exception:
+            pass
+
+
+def request_device_trace(num_steps: int = 3,
+                         trace_dir: Optional[str] = None) -> bool:
+    """Arm a device-trace window on the current recorder; False when no
+    recorder is registered in this process."""
+    rec = current_recorder()
+    if rec is None:
+        return False
+    rec.device_trace.request(num_steps, trace_dir)
+    return True
 
 
 # ----------------------------------------------------- process-global hookup

@@ -33,6 +33,15 @@ change (add new series instead). The stable set:
     ray_tpu_serve_request_latency_seconds        histogram (replica-side)
     ray_tpu_serve_handle_latency_seconds         histogram (caller-side)
     ray_tpu_serve_handle_requests_total          counter
+
+  profiling plane (_private/watchdog.py, labels: trigger — the incident
+  kind or trigger that caused the capture: slow_step, stuck_task, ...)
+    ray_tpu_profile_captures_total               counter, automatic
+                                                 cluster-profile captures
+
+The RTPU_profile_* / RTPU_device_trace_steps config flags are likewise a
+stability contract — see the profiling-plane section of
+``ray_tpu/_private/config.py``.
 """
 
 from __future__ import annotations

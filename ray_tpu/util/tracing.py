@@ -80,8 +80,10 @@ def is_enabled() -> bool:
 
 
 def _mark_enabled():
-    """Executor-side fast path: a spec carrying trace_ctx proves tracing
-    was on at submission — skip the KV round-trip for this window."""
+    """Executor-side fast path: a spec carrying trace_ctx (its ``enabled``
+    bit) proves tracing was on at submission — adopt that immediately
+    instead of waiting out the KV cache TTL, so a fresh worker's first
+    task records its spans from the first instruction."""
     global _local_enabled, _checked_at
     _local_enabled, _checked_at = True, time.time()
 
@@ -102,13 +104,20 @@ def context_for_spec() -> Optional[Dict[str, str]]:
     span becomes the remote task's parent). A submission with no open span
     roots a fresh one-off trace — it is NOT installed as the caller's
     context, so unrelated submissions don't collapse into one giant trace
-    hanging off a never-recorded synthetic parent."""
+    hanging off a never-recorded synthetic parent.
+
+    The ctx carries an explicit ``enabled`` bit: the executing worker
+    treats a spec-borne context as proof tracing is on and marks its local
+    cache (``_mark_enabled``) instead of waiting out the GCS-KV cache TTL —
+    without it, a freshly started worker (or one holding a stale
+    disabled-cache) silently dropped the task's early spans for up to
+    ``_CACHE_TTL_S`` seconds."""
     if not is_enabled():
         return None
     ctx = _current.get()
     if ctx is None:
         ctx = new_context()
-    return dict(ctx)
+    return {**ctx, "enabled": True}
 
 
 @contextlib.contextmanager
