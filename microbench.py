@@ -4,7 +4,12 @@
 
 Run:  python microbench.py                      # full table, writes MICROBENCH.md
       python microbench.py --only put           # just metrics matching 'put'
-                                                # (substring; prints, no file write)
+                                                # (comma-separated substrings;
+                                                # prints, no file write)
+      python microbench.py --json [--only ...]  # machine-readable line for the
+                                                # perf gate/CI: per-metric value
+                                                # + rep min/median/max (schema
+                                                # microbench.v1; no file write)
       python -c 'import microbench; print(microbench.run_quick())'
 
 Numbers compare against BASELINE.md (reference release rig, m5.16xlarge):
@@ -22,8 +27,14 @@ import numpy as np
 
 _REPS = 1  # set by run_benches: 3 for the committed table, 1 for quick
 
+# Per-metric rep spread of the last run_benches call, keyed by the metric
+# name (timeit's `key`): {"min", "median", "max", "reps"}. The --json output
+# and perf_gate consume this instead of scraping the printed table.
+_REP_DETAIL = {}
 
-def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0, reps=None):
+
+def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0, reps=None,
+           key=None):
     """Run fn repeatedly for >= min_time, `reps` times back-to-back in the
     same process state; report the MEDIAN rep's ops/s. Mirrors
     ray_perf.timeit plus a pinned repetition protocol — single runs on this
@@ -45,11 +56,35 @@ def timeit(name, fn, multiplier=1, warmup=1, min_time=2.0, reps=None):
         rates.append(count * multiplier / dt)
     rates.sort()
     rate = rates[len(rates) // 2]
+    _REP_DETAIL[key or name] = {
+        "min": min(rates), "median": rate, "max": max(rates), "reps": reps}
     spread = (
         f"  (min {min(rates):,.0f} max {max(rates):,.0f})" if reps > 1 else ""
     )
     print(f"  {name}: {rate:,.1f} /s{spread}")
     return rate
+
+
+def _scale_detail(key, factor):
+    """Apply a post-hoc unit conversion (e.g. puts/s -> GiB/s) to a rep
+    detail record so --json reports the same unit as the table."""
+    d = _REP_DETAIL.get(key)
+    if d:
+        for f in ("min", "median", "max"):
+            d[f] *= factor
+
+
+def last_run_detail() -> dict:
+    """{metric: {"value", "min", "median", "max", "reps"}} for the metrics
+    the last run_benches() call measured."""
+    return {
+        k: {"value": round(d["median"], 3),
+            "min": round(d["min"], 3),
+            "median": round(d["median"], 3),
+            "max": round(d["max"], 3),
+            "reps": d["reps"]}
+        for k, d in _REP_DETAIL.items()
+    }
 
 
 def _define_remotes():
@@ -95,22 +130,24 @@ def _define_remotes():
 
 
 def run_benches(quick: bool = False, only: str = None) -> dict:
-    """Run the bench table. `only` (substring match on the metric name)
-    restricts the run to matching metrics — each section boots only the
-    actors it needs, so `--only put` answers "did the put path regress?"
-    in seconds instead of a full bench round."""
+    """Run the bench table. `only` (comma-separated substring match on the
+    metric name) restricts the run to matching metrics — each section boots
+    only the actors it needs, so `--only put` answers "did the put path
+    regress?" in seconds instead of a full bench round."""
     import ray_tpu
     from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
     global _REPS
     small_task, Actor, AsyncActor, Client = _define_remotes()
     results = {}
+    _REP_DETAIL.clear()
     min_time = 0.5 if quick else 2.0
     batch = 100 if quick else 1000
     _REPS = 1 if quick else 3
+    parts = [p for p in (only or "").split(",") if p]
 
     def sel(metric: str) -> bool:
-        return only is None or only in metric
+        return not parts or any(p in metric for p in parts)
 
     ray_tpu.init(num_cpus=8)
     try:
@@ -121,12 +158,13 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
             results["single_client_tasks_sync"] = timeit(
                 "single client tasks sync",
                 lambda: ray_tpu.get(small_task.remote()),
-                min_time=min_time)
+                min_time=min_time, key="single_client_tasks_sync")
         if sel("single_client_tasks_async"):
             results["single_client_tasks_async"] = timeit(
                 "single client tasks async",
                 lambda: ray_tpu.get([small_task.remote() for _ in range(batch)]),
-                multiplier=batch, min_time=min_time)
+                multiplier=batch, min_time=min_time,
+                key="single_client_tasks_async")
 
         # wait() at 1k-ref scale (reference: release/benchmarks single-node
         # ray.get/wait batch limits)
@@ -140,7 +178,7 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
 
             results["wait_1k_refs"] = timeit(
                 "wait on 1k refs", wait_cycle, multiplier=wait_n,
-                min_time=min_time)
+                min_time=min_time, key="wait_1k_refs")
 
         # multi-client task submission: n driver-like client actors each
         # submitting async task batches (ray_perf multi_client_tasks_async)
@@ -154,7 +192,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 lambda: ray_tpu.get(
                     [c.task_batch.remote(per_cli) for c in task_clients]
                 ),
-                multiplier=n_cli * per_cli, min_time=min_time)
+                multiplier=n_cli * per_cli, min_time=min_time,
+                key="multi_client_tasks_async")
             for c in task_clients:
                 ray_tpu.kill(c)
 
@@ -166,13 +205,14 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 results["1_1_actor_calls_sync"] = timeit(
                     "1:1 actor calls sync",
                     lambda: ray_tpu.get(a.small_value.remote()),
-                    min_time=min_time)
+                    min_time=min_time, key="1_1_actor_calls_sync")
             if sel("1_1_actor_calls_async"):
                 results["1_1_actor_calls_async"] = timeit(
                     "1:1 actor calls async",
                     lambda: ray_tpu.get(
                         [a.small_value.remote() for _ in range(batch)]),
-                    multiplier=batch, min_time=min_time)
+                    multiplier=batch, min_time=min_time,
+                    key="1_1_actor_calls_async")
             ray_tpu.kill(a)
 
         if sel("1_1_async_actor_calls_async"):
@@ -182,7 +222,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 "1:1 async-actor calls async",
                 lambda: ray_tpu.get(
                     [aa.small_value.remote() for _ in range(batch)]),
-                multiplier=batch, min_time=min_time)
+                multiplier=batch, min_time=min_time,
+                key="1_1_async_actor_calls_async")
             ray_tpu.kill(aa)
 
         # n:n actor calls — n clients (separate processes) × n servers
@@ -197,7 +238,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 "n:n actor calls async",
                 lambda: ray_tpu.get(
                     [c.actor_batch.remote(per) for c in clients]),
-                multiplier=n * n * per, min_time=min_time)
+                multiplier=n * n * per, min_time=min_time,
+                key="n_n_actor_calls_async")
             for actor in servers + clients:
                 ray_tpu.kill(actor)
 
@@ -207,14 +249,16 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
             results["single_client_put_calls"] = timeit(
                 "single client put calls (100B)",
                 lambda: ray_tpu.put(small),
-                min_time=min_time)
+                min_time=min_time, key="single_client_put_calls")
         if sel("single_client_put_gigabytes"):
             big = np.zeros(256 * 1024 * 1024 // 8, dtype=np.float64)  # 256 MiB
             gib = big.nbytes / (1 << 30)
             results["single_client_put_gigabytes"] = timeit(
                 "single client put GiB/s",
                 lambda: ray_tpu.put(big),
-                multiplier=1, min_time=min_time) * gib
+                multiplier=1, min_time=min_time,
+                key="single_client_put_gigabytes") * gib
+            _scale_detail("single_client_put_gigabytes", gib)
 
         # plasma get calls
         if sel("single_client_get_calls_plasma"):
@@ -222,7 +266,7 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
             results["single_client_get_calls_plasma"] = timeit(
                 "single client plasma get calls",
                 lambda: ray_tpu.get(ref),
-                min_time=min_time)
+                min_time=min_time, key="single_client_get_calls_plasma")
 
         if sel("placement_group_create_removal"):
             def pg_cycle():
@@ -231,7 +275,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 remove_placement_group(pg)
 
             results["placement_group_create_removal"] = timeit(
-                "pg create+remove", pg_cycle, min_time=min_time)
+                "pg create+remove", pg_cycle, min_time=min_time,
+                key="placement_group_create_removal")
     finally:
         ray_tpu.shutdown()
     return {k: round(v, 1) for k, v in results.items()}
@@ -260,13 +305,34 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--only", default=None, metavar="METRIC",
-        help="run only metrics whose name contains this substring "
-             "(e.g. 'put', 'single_client_put_gigabytes'); prints results "
-             "as JSON without rewriting MICROBENCH.md")
+        help="run only metrics whose name contains one of these "
+             "comma-separated substrings (e.g. 'put', "
+             "'single_client,1_1_actor'); prints results as JSON without "
+             "rewriting MICROBENCH.md")
     ap.add_argument(
         "--quick", action="store_true",
         help="reduced-duration single-rep pass (bench.py protocol)")
+    ap.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="print one machine-readable JSON line (schema microbench.v1: "
+             "per-metric value + rep min/median/max) instead of rewriting "
+             "MICROBENCH.md — the perf gate and CI consume this")
     args = ap.parse_args()
+    if args.as_json:
+        import os
+
+        results = run_benches(quick=args.quick, only=args.only)
+        if not results:
+            raise SystemExit(f"no metric matches --only {args.only!r}")
+        print(json.dumps({
+            "schema": "microbench.v1",
+            "time": time.time(),
+            "quick": args.quick,
+            "reps": 1 if args.quick else 3,
+            "host": {"cpus": os.cpu_count()},
+            "metrics": last_run_detail(),
+        }))
+        return
     if args.only is not None:
         results = run_benches(quick=args.quick, only=args.only)
         if not results:
@@ -295,6 +361,28 @@ def main():
         "",
         "See PROFILE.md for where the submit/push hot-path time goes and",
         "what rounds 3-6 changed.",
+        "",
+        "## Noise bands (what counts as a regression)",
+        "",
+        "The perf gate (`ray-tpu perf check`, `_private/perf_gate.py`,",
+        "`.github/workflows/perf.yml`) turns the spread above into explicit",
+        "per-metric thresholds. A comparison's band is chosen by the LESS",
+        "reliable side (min reps of baseline and current), then scaled by",
+        "`RTPU_perf_band_scale`; a drop beyond the band fails the gate, a rise",
+        "beyond it is flagged `improved`.",
+        "",
+        "| metric | 1-rep band | 3-rep-median band | extra variance source |",
+        "|---|---|---|---|",
+        "| (default) | ±40% | ±25% | single runs swing ±25-30% on this box |",
+        "| multi_client_tasks_async | ±50% | ±35% | processes timeshare one core |",
+        "| n_n_actor_calls_async | ±50% | ±35% | processes timeshare one core |",
+        "| single_client_put_gigabytes | ±45% | ±30% | store page-fault state (cold ~2.1 vs steady 6.7 GiB/s) |",
+        "| wait_1k_refs | ±45% | ±30% | timer batching across the submit window |",
+        "",
+        "The committed trajectory lives in `PERF_HISTORY.jsonl` (append with",
+        "`ray-tpu perf check --update` when refreshing this table);",
+        "`microbench.py --json` emits the machine-readable per-metric",
+        "value + rep min/median/max the gate consumes.",
         "",
         "| metric | ray_tpu | reference | ratio |",
         "|---|---|---|---|",

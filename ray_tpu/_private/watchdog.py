@@ -12,7 +12,11 @@ beside every raylet (NodeManager._watchdog_loop) and fires when:
   - work is pending but the completion counter has not moved for the same
     window (actor queue growing without completions);
   - train-step telemetry (train/_telemetry.StepRecorder) recorded steps
-    and then went silent for ``RTPU_watchdog_step_timeout_s``.
+    and then went silent for ``RTPU_watchdog_step_timeout_s``;
+  - the StepRecorder flagged a slow step (``slow_step``) or a post-warmup
+    recompilation storm (``jit_cache_miss_storm``,
+    ``RTPU_perf_compile_storm_k`` compiles inside
+    ``RTPU_perf_compile_storm_window_s``).
 
 On trigger it captures evidence while the hang is still live — its own
 stacks via profiling.sample_stacks, the stuck task's executing worker via
@@ -119,6 +123,21 @@ def capture_incident_profile(core, reason: str) -> Optional[str]:
 
 
 _capture_counter = None
+_storm_counter = None
+
+
+def _record_storm_metric():
+    global _storm_counter
+    try:
+        from ray_tpu.util.metrics import Counter
+
+        if _storm_counter is None:
+            _storm_counter = Counter(
+                "ray_tpu_perf_compile_storms_total",
+                "jit_cache_miss_storm incidents raised by the watchdog")
+        _storm_counter.inc()
+    except Exception:
+        pass
 
 
 def _record_capture_metric(reason: str):
@@ -146,9 +165,10 @@ class StallWatchdog:
         self._thread: Optional[threading.Thread] = None
         self._fired: set = set()  # dedupe keys, one incident per subject
         self._progress = (0, time.time())  # (tasks_completed, t of change)
-        # Slow steps recur by nature, so they rate-limit on a cooldown
-        # instead of the once-per-subject set.
+        # Slow steps and compile storms recur by nature, so they rate-limit
+        # on a cooldown instead of the once-per-subject set.
         self._last_slow_capture = 0.0
+        self._last_storm_fire = 0.0
 
     def start(self):
         self._thread = threading.Thread(
@@ -231,6 +251,19 @@ class StallWatchdog:
                 self._last_slow_capture = now
                 self._fire_slow_step(slow)
 
+        # 5. jit-cache-miss storm: the StepRecorder counts post-warmup
+        #    recompilations (previously detected, logged, and dropped) —
+        #    many inside one window means throughput is being eaten by XLA
+        #    retracing (unstable shapes/dtypes), which deserves an incident
+        #    with an attached capture, not a log line nobody reads.
+        if rec is not None and hasattr(rec, "pop_compile_storm"):
+            storm = rec.pop_compile_storm()
+            cooldown = RTPU_CONFIG.profile_slow_step_cooldown_s
+            if (storm is not None
+                    and now - self._last_storm_fire >= cooldown):
+                self._last_storm_fire = now
+                self._fire_compile_storm(storm)
+
     # -------------------------------------------------------------- firing
 
     def _fire_stuck_task(self, task_id: bytes, rec: dict, now: float):
@@ -275,6 +308,21 @@ class StallWatchdog:
             incident["profile_path"] = path
         self._publish(incident, b"")
 
+    def _fire_compile_storm(self, storm: dict):
+        incident = build_incident(
+            "jit_cache_miss_storm", self.core.mode,
+            f"{int(storm.get('compiles', 0))} jit compiles within "
+            f"{storm.get('window_s', 0):.0f}s after warmup (at step "
+            f"{int(storm.get('step', 0))}, {storm.get('compile_s', 0):.1f}s "
+            "cumulative compile time) — the step fn is being retraced",
+            node_id=self.core.node_id.hex() if self.core.node_id else "",
+            worker_id=self.core.worker_id.hex(),
+        )
+        incident["compile_storm"] = {
+            k: float(v) for k, v in storm.items()}
+        _record_storm_metric()
+        self._publish(incident, b"")
+
     def _gather_stacks(self, exec_worker_id) -> list:
         stacks = []
         try:
@@ -316,6 +364,17 @@ class StallWatchdog:
             path = capture_incident_profile(self.core, incident["kind"])
             if path:
                 incident["profile_path"] = path
+        if incident.get("profile_path"):
+            # Auto-analysis: read the capture back and record the "why"
+            # (top stacks, compile share, scheduling delay) inside the
+            # incident itself — the record must stay useful even when the
+            # capture file's host is gone by the time someone looks.
+            try:
+                from ray_tpu._private import perf_analysis
+
+                perf_analysis.attach_analysis(incident)
+            except Exception:
+                pass
         try:
             self.core.gcs.call(
                 "ReportIncident", {"incident": incident}, timeout=10)

@@ -160,6 +160,17 @@ class StepRecorder:
         self._median_cache: Optional[float] = None  # refreshed every 8 steps
         self._steps_since_median = 0
         self._slow_step: Optional[Dict[str, float]] = None
+        # Compile-storm detection (perf regression plane): the jit-cache-miss
+        # bookkeeping above already *knows* every recompilation; this turns
+        # "many compiles long after warmup" — the unstable-shapes/dtypes
+        # failure mode that silently halves throughput — into a flag the
+        # watchdog promotes to a jit_cache_miss_storm GCS incident. Config
+        # snapshotted once (per-step path).
+        self._storm_k = int(RTPU_CONFIG.perf_compile_storm_k)
+        self._storm_window = float(RTPU_CONFIG.perf_compile_storm_window_s)
+        self._storm_warmup = int(RTPU_CONFIG.perf_compile_warmup_steps)
+        self._compile_times: deque = deque(maxlen=64)
+        self._compile_storm: Optional[Dict[str, float]] = None
         # Device-trace window (jax.profiler) armed via request_device_trace
         # or RTPU_device_trace_steps; driven by TrainStep around dispatch.
         self.device_trace = DeviceTraceController()
@@ -191,6 +202,19 @@ class StepRecorder:
             self._last_step_at = self._clock()
             if compile_step:
                 self.compile_s += duration_s
+                if self._storm_k > 0 and self.steps > self._storm_warmup:
+                    now_m = self._clock()
+                    self._compile_times.append(now_m)
+                    recent = [t for t in self._compile_times
+                              if now_m - t <= self._storm_window]
+                    if len(recent) >= self._storm_k:
+                        self._compile_storm = {
+                            "compiles": len(recent),
+                            "window_s": self._storm_window,
+                            "step": self.steps,
+                            "compile_s": self.compile_s,
+                            "time": self._wall(),
+                        }
             else:
                 self.productive_s += duration_s
                 self.productive_steps += steps
@@ -251,6 +275,15 @@ class StepRecorder:
         capture + ``slow_step`` incident."""
         with self._lock:
             out, self._slow_step = self._slow_step, None
+            return out
+
+    def pop_compile_storm(self) -> Optional[Dict[str, float]]:
+        """Pending compile-storm flag (> K post-warmup jit compiles within
+        the configured window), cleared on read. The watchdog polls this and
+        publishes a ``jit_cache_miss_storm`` incident with an attached
+        cluster capture + auto-analysis."""
+        with self._lock:
+            out, self._compile_storm = self._compile_storm, None
             return out
 
     # ------------------------------------------------------------- derived

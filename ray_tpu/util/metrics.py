@@ -39,9 +39,18 @@ change (add new series instead). The stable set:
     ray_tpu_profile_captures_total               counter, automatic
                                                  cluster-profile captures
 
-The RTPU_profile_* / RTPU_device_trace_steps config flags are likewise a
-stability contract — see the profiling-plane section of
-``ray_tpu/_private/config.py``.
+  perf regression plane (_private/perf_gate.py + _private/watchdog.py)
+    ray_tpu_perf_regressions_total     counter, labels: metric — gate
+                                       comparisons landing beyond the
+                                       noise band (perf check/compare)
+    ray_tpu_perf_gate_ratio            gauge, labels: metric — latest
+                                       current/baseline ratio per metric
+    ray_tpu_perf_compile_storms_total  counter — jit_cache_miss_storm
+                                       incidents raised by the watchdog
+
+The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* config flags are
+likewise a stability contract — see the profiling-plane and
+perf-regression-plane sections of ``ray_tpu/_private/config.py``.
 """
 
 from __future__ import annotations
