@@ -161,6 +161,38 @@ _FLAGS: Dict[str, Any] = {
     "perf_compile_storm_k": 3,
     "perf_compile_storm_window_s": 120.0,
     "perf_compile_warmup_steps": 4,
+    # --- memory observability plane (stability contract) --------------------
+    # Same contract as the profiling/perf flags above: operators key on
+    # these names (README "Hunting a memory leak", alerting automation).
+    #   memory_ledger_callsite       capture the user callsite (file:line)
+    #                                of every ray.put-shaped object
+    #                                creation in the ownership ledger
+    #                                (one bounded frame walk per put;
+    #                                0 disables, rows show "")
+    #   memory_snapshot_period_s     cadence of the per-worker on-disk
+    #                                memory snapshot
+    #                                (<session>/logs/memory_worker-<pid>
+    #                                .json) that OOM forensics attaches to
+    #                                death reports; 0 disables
+    #   memory_report_top_n          ledger rows per worker in RPC reports
+    #                                and snapshots (top holders by size)
+    #   memory_leak_sweep_period_s   cadence of the raylet's leak sweep
+    #                                (pinned/spilled primaries with no
+    #                                live ref in any owner's ledger,
+    #                                confirmed across two sweeps);
+    #                                0 disables
+    #   memory_leak_min_age_s        objects younger than this are never
+    #                                leak candidates (in-flight guard on
+    #                                top of the two-sweep cross-check)
+    #   memory_leak_cooldown_s       minimum gap between object_leak
+    #                                incidents from one raylet (each leaked
+    #                                object is reported at most once)
+    "memory_ledger_callsite": True,
+    "memory_snapshot_period_s": 10.0,
+    "memory_report_top_n": 50,
+    "memory_leak_sweep_period_s": 60.0,
+    "memory_leak_min_age_s": 30.0,
+    "memory_leak_cooldown_s": 300.0,
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

@@ -41,7 +41,12 @@ bytes, hex-encoded at dump time) and a short detail string/number.
   task.failed / task.retry       task state transitions (mirrors the GCS
                                  task-event states, lowercased)
   obj.put                        plasma/inline store of an owned object
-  obj.spill / obj.restore        raylet spill-to-disk and restore
+  obj.spill / obj.restore        raylet spill-to-disk and restore, one
+                                 event per object: (oid, bytes) — the
+                                 timeline renders these as instants on
+                                 the owning node's lane
+  obj.leak                       the leak detector confirmed a primary
+                                 with no live owner reference (oid, bytes)
   obj.pull / obj.push            node-to-node object transfer attempts
   rpc.error                      a transport-level RPC failure at a
                                  recorded call site (lease push, reply

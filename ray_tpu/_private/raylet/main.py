@@ -119,6 +119,21 @@ class NodeManager:
         self._spill_lock = asyncio.Lock()
         # worker_id -> reason, for deaths we caused (OOM kills)
         self._kill_reasons: Dict[bytes, str] = {}
+        # --- memory observability plane --------------------------------
+        # object_id -> ownership attribution shipped with PinObject
+        # ({owner_addr, job_id, actor_id, task_id, callsite, size, t});
+        # joined against _pinned/_spilled by GetMemoryReport and the leak
+        # sweep, dropped with the object in FreeObjects.
+        self._pin_meta: Dict[bytes, dict] = {}
+        # leak detector state: first-unowned-seen time per candidate, the
+        # confirmed-leak records (still present), and ids already reported
+        self._leak_candidates: Dict[bytes, float] = {}
+        self._leaks: Dict[bytes, dict] = {}
+        self._leak_fired: set = set()
+        self._last_leak_incident = 0.0
+        # OOM forensics: live-grabbed memory report of a worker we are
+        # about to kill (worker_id -> report), attached to its death report
+        self._death_memory: Dict[bytes, dict] = {}
         self._bg = []
         try:
             import psutil
@@ -168,6 +183,8 @@ class NodeManager:
         self._bg.append(asyncio.ensure_future(self._cluster_view_loop()))
         self._bg.append(asyncio.ensure_future(self._spill_loop()))
         self._bg.append(asyncio.ensure_future(self._memory_monitor_loop()))
+        if RTPU_CONFIG.memory_leak_sweep_period_s > 0:
+            self._bg.append(asyncio.ensure_future(self._leak_sweep_loop()))
         self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         if RTPU_CONFIG.watchdog_interval_s > 0:
             self._bg.append(asyncio.ensure_future(self._watchdog_loop()))
@@ -239,6 +256,36 @@ class NodeManager:
              sum(size for _, size in self._spilled.values()))
         )
         samples.append(("ray_tpu_pulls_in_flight", {"node": node}, len(self._pulls)))
+        # memory observability plane (stability contract, util/metrics.py)
+        samples.append(
+            ("ray_tpu_object_store_pinned_bytes", {"node": node},
+             sum(v.nbytes for v in self._pinned.values()))
+        )
+        samples.append(
+            ("ray_tpu_object_store_leaked_bytes", {"node": node},
+             sum(r["size"] for r in self._leaks.values()))
+        )
+        try:
+            from ray_tpu._private import memory_report as _mr
+
+            samples.append(
+                ("ray_tpu_memory_rss_bytes", {"node": node, "role": "raylet"},
+                 _mr.process_rss())
+            )
+            samples.append(
+                ("ray_tpu_memory_rss_bytes", {"node": node, "role": "worker"},
+                 sum(_mr.process_rss(h.pid)
+                     for h in self.worker_pool.workers.values() if h.pid))
+            )
+            agent_pid = getattr(getattr(self, "_agent_proc", None), "pid", None)
+            if agent_pid:
+                samples.append(
+                    ("ray_tpu_memory_rss_bytes",
+                     {"node": node, "role": "agent"},
+                     _mr.process_rss(agent_pid))
+                )
+        except Exception:
+            pass
         # per-node host stats (reference: dashboard reporter_agent.py:314
         # psutil cpu/mem/per-worker probes)
         try:
@@ -545,6 +592,14 @@ class NodeManager:
         tail = self._worker_flight_tail(handle.pid)
         if tail:
             reason = f"{reason}\nlast flight-recorder events of the worker:\n{tail}"
+        # OOM forensics: the worker's final memory report — live-grabbed by
+        # the memory monitor just before an OOM kill, else the periodic
+        # on-disk snapshot (survives SIGKILL, same pattern as the flight
+        # tail) — rides the death report into ActorDiedError, so "what was
+        # resident when it died" is IN the error the caller sees.
+        mem_tail = self._worker_memory_tail(handle)
+        if mem_tail:
+            reason = f"{reason}\nmemory snapshot at death (top holders):\n{mem_tail}"
         await self.gcs.notify(
             "ReportWorkerDeath",
             {
@@ -554,6 +609,19 @@ class NodeManager:
                 "reason": reason,
             },
         )
+
+    def _worker_memory_tail(self, handle) -> str:
+        from ray_tpu._private import memory_report as _mr
+
+        report = self._death_memory.pop(handle.worker_id, None)
+        if report is None and handle.pid and self.session_dir:
+            report = _mr.read_snapshot(self.session_dir, handle.pid)
+        if not report:
+            return ""
+        try:
+            return _mr.format_top_holders(report)[:1500]
+        except Exception:
+            return ""
 
     def _worker_flight_tail(self, pid, limit: int = 8) -> str:
         if not pid or not self.session_dir:
@@ -1462,8 +1530,10 @@ class NodeManager:
                 # frees when that reader releases — still progress.
                 self.plasma.delete(oid)
                 freed += nbytes
+                # per-object (oid, bytes) so the timeline can render each
+                # spill as an instant on this node's lane
+                _fr.record("obj.spill", oid, nbytes)
             if freed:
-                _fr.record("obj.spill", b"", f"{len(victims)} objs {freed}B")
                 logger.info(
                     "spilled %d objects / %d bytes to %s",
                     len(victims), freed, self._spill_dir,
@@ -1625,9 +1695,215 @@ class NodeManager:
                 _fr.record("worker.oom_kill", victim.worker_id,
                            f"pid {victim.pid} frac {frac:.2f}")
                 self._kill_reasons[victim.worker_id] = reason
+                # OOM forensics: grab the victim's final memory report
+                # while it still breathes — _on_worker_death attaches it
+                # (or the on-disk snapshot fallback) to the death report.
+                try:
+                    client = await self.pool.get(*victim.addr)
+                    r = await client.call(
+                        "GetMemoryReport", {"limit": 10}, timeout=2)
+                    if r.get("report"):
+                        self._death_memory[victim.worker_id] = r["report"]
+                except Exception:
+                    pass
                 await self.worker_pool.kill_worker(victim)
             except Exception:
                 logger.exception("memory monitor error")
+
+    # ------------------------------------- memory plane: ledger + leaks
+
+    async def _leak_sweep_loop(self):
+        """Leak detector: a pinned/spilled primary whose owner's ledger
+        holds no live reference — in two consecutive sweeps — is leaked
+        (one sweep alone can race an in-flight free/borrow handoff). Fires
+        one ``object_leak`` incident per batch of newly confirmed leaks
+        through the PR 3 incident path, cooldown-limited, each object
+        reported at most once."""
+        period = RTPU_CONFIG.memory_leak_sweep_period_s
+        while True:
+            await asyncio.sleep(period)
+            try:
+                await self._leak_sweep_once()
+            except Exception:
+                logger.exception("leak sweep error")
+
+    async def _leak_sweep_once(self):
+        now = time.time()
+        min_age = RTPU_CONFIG.memory_leak_min_age_s
+        # 1. group this node's primaries by owner address
+        by_owner: Dict[tuple, List[bytes]] = {}
+        for oid in set(self._pinned) | set(self._spilled):
+            meta = self._pin_meta.get(oid)
+            if not meta or not meta.get("owner_addr"):
+                continue  # no attribution: nothing to cross-check against
+            if now - meta.get("t", now) < min_age:
+                continue  # too young — likely still being wired up
+            by_owner.setdefault(tuple(meta["owner_addr"]), []).append(oid)
+        # 2. ask each owner which ids its ledger still holds
+        unowned: List[bytes] = []
+        for owner, ids in by_owner.items():
+            try:
+                client = await self.pool.get(owner[0], owner[1])
+                reply = await client.call("CheckRefs", {"ids": ids},
+                                          timeout=10)
+                owned = reply.get("owned", [])
+                unowned.extend(
+                    oid for oid, ok in zip(ids, owned) if not ok)
+            except Exception:
+                # unreachable owner (died without the raylet learning, or
+                # network partition): every primary it pinned is suspect
+                unowned.extend(ids)
+        # 3. two-sweep cross-check: confirmed = unowned now AND last sweep
+        confirmed = [oid for oid in unowned if oid in self._leak_candidates]
+        self._leak_candidates = {
+            oid: self._leak_candidates.get(oid, now) for oid in unowned}
+        self._leaks = {
+            oid: self._leak_record(oid) for oid in confirmed}
+        # 4. publish newly confirmed leaks (once per object, cooldown gap)
+        new = [oid for oid in confirmed if oid not in self._leak_fired]
+        if not new:
+            return
+        cooldown = RTPU_CONFIG.memory_leak_cooldown_s
+        if now - self._last_leak_incident < cooldown:
+            return  # they stay in _leaks/_leak_candidates; next window
+        self._last_leak_incident = now
+        self._leak_fired.update(new)
+        records = [self._leaks[oid] for oid in new]
+        for rec in records:
+            _fr.record("obj.leak", bytes.fromhex(rec["object_id"]),
+                       rec["size"])
+        await self._fire_leak_incident(records)
+
+    def _leak_record(self, oid: bytes) -> dict:
+        meta = self._pin_meta.get(oid, {})
+        view = self._pinned.get(oid)
+        size = view.nbytes if view is not None else (
+            self._spilled.get(oid, (None, meta.get("size", 0)))[1])
+
+        def _hex(v):
+            return v.hex() if isinstance(v, (bytes, bytearray)) else (v or "")
+
+        return {
+            "object_id": oid.hex(),
+            "size": size,
+            "node_id": self.node_id.hex(),
+            "job_id": _hex(meta.get("job_id")),
+            "actor_id": _hex(meta.get("actor_id")),
+            "task_id": _hex(meta.get("task_id")),
+            "callsite": meta.get("callsite", ""),
+            "owner_addr": list(meta.get("owner_addr") or []),
+            "spilled": oid in self._spilled and oid not in self._pinned,
+            "first_unowned": self._leak_candidates.get(oid, 0.0),
+        }
+
+    async def _fire_leak_incident(self, records: List[dict]):
+        from ray_tpu._private import watchdog as _wd
+
+        total = sum(r["size"] for r in records)
+        top = max(records, key=lambda r: r["size"])
+        where = f" @ {top['callsite']}" if top.get("callsite") else ""
+        incident = _wd.build_incident(
+            "object_leak", "raylet",
+            f"{len(records)} leaked object(s) / {total} bytes in plasma on "
+            f"node {self.node_id.hex()[:12]}: no live reference in any "
+            f"owner's ledger across two sweeps — largest "
+            f"{top['object_id'][:12]} ({top['size']} bytes, job "
+            f"{top['job_id'][:12] or '?'}"
+            + (f", actor {top['actor_id'][:12]}" if top["actor_id"] else "")
+            + f"){where}",
+            node_id=self.node_id.hex(),
+        )
+        incident["leaks"] = records
+        try:
+            await self.gcs.call(
+                "ReportIncident", {"incident": incident}, timeout=10)
+        except Exception:
+            pass
+
+    async def handle_GetMemoryReport(self, req):
+        """Memory plane fan-in: this node's plasma + spill + pin tables
+        joined with every live worker's ownership ledger and per-role RSS
+        in one reply (util.state aggregates the cluster view).
+        ``sweep=True`` forces a leak sweep first (`ray-tpu memory --leaks`
+        wants current truth, not the last cadence's)."""
+        from ray_tpu._private import memory_report as _mr
+
+        if req.get("sweep"):
+            try:
+                await self._leak_sweep_once()
+            except Exception:
+                logger.exception("forced leak sweep failed")
+        limit = req.get("limit") or RTPU_CONFIG.memory_report_top_n
+        try:
+            plasma_stats = self.plasma.stats()
+        except Exception:
+            plasma_stats = {}
+        pinned_bytes = sum(v.nbytes for v in self._pinned.values())
+        spilled_bytes = sum(size for _, size in self._spilled.values())
+
+        def _meta_out(oid):
+            meta = self._pin_meta.get(oid, {})
+            return {
+                "job_id": meta.get("job_id") or b"",
+                "actor_id": meta.get("actor_id") or b"",
+                "task_id": meta.get("task_id") or b"",
+                "callsite": meta.get("callsite", ""),
+                "owner_addr": list(meta.get("owner_addr") or []),
+            }
+
+        objects = []
+        seen = set()
+        for oid in self.plasma.list_object_ids():
+            b = oid.binary()
+            seen.add(b)
+            size = None
+            view = self.plasma.get(b)
+            if view is not None:
+                size = view.nbytes
+                view.release()
+                self.plasma.release(b)
+            objects.append({
+                "object_id": b, "size": size,
+                "pinned": b in self._pinned, "spilled": b in self._spilled,
+                **_meta_out(b),
+            })
+        for oid, (_path, size) in self._spilled.items():
+            if oid not in seen:
+                objects.append({
+                    "object_id": oid, "size": size,
+                    "pinned": False, "spilled": True, **_meta_out(oid),
+                })
+        out = {
+            "node_id": self.node_id.binary(),
+            "time": time.time(),
+            "plasma": plasma_stats,
+            "pinned_count": len(self._pinned),
+            "pinned_bytes": pinned_bytes,
+            "spilled_count": len(self._spilled),
+            "spilled_bytes": spilled_bytes,
+            "objects": objects,
+            "leaks": list(self._leaks.values()),
+            "leak_candidates": len(self._leak_candidates),
+            "raylet_rss": _mr.process_rss(),
+            "agent_rss": _mr.process_rss(
+                getattr(getattr(self, "_agent_proc", None), "pid", None)),
+            "workers": [],
+        }
+        if req.get("include_workers", True):
+            async def _one(h):
+                try:
+                    client = await self.pool.get(*h.addr)
+                    r = await client.call(
+                        "GetMemoryReport", {"limit": limit}, timeout=10)
+                    return r.get("report")
+                except Exception:
+                    return None
+
+            live = [h for h in self.worker_pool.workers.values()
+                    if h.alive and h.addr[1]]
+            replies = await asyncio.gather(*(_one(h) for h in live))
+            out["workers"] = [r for r in replies if r]
+        return out
 
     # ------------------------------------------------------------ log monitor
 
@@ -1722,6 +1998,12 @@ class NodeManager:
             view = self.plasma.get(oid)
             if view is not None:
                 self._pinned[oid] = view
+        # Ownership attribution for the memory plane: who to ask (leak
+        # sweep) and who to blame (reports) for this primary.
+        meta = dict(req.get("meta") or {})
+        meta["owner_addr"] = req.get("owner_addr")
+        meta.setdefault("t", time.time())
+        self._pin_meta[oid] = meta
 
     async def handle_FreeObjects(self, req):
         for oid in req["ids"]:
@@ -1739,6 +2021,11 @@ class NodeManager:
                     os.remove(spilled[0])
                 except OSError:
                     pass
+            # freed is not leaked: drop the object's memory-plane state
+            self._pin_meta.pop(oid, None)
+            self._leak_candidates.pop(oid, None)
+            self._leaks.pop(oid, None)
+            self._leak_fired.discard(oid)
 
     async def handle_FetchObjectInfo(self, req):
         oid = req["object_id"]

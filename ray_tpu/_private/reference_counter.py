@@ -16,8 +16,9 @@ owner) involves no RPCs at all.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.ids import ObjectID
 
@@ -32,6 +33,14 @@ class OwnedRef:
     # Lineage: spec of the task that can recreate this object (for reconstruction).
     lineage_task_id: Optional[bytes] = None
     freed: bool = False
+    # --- ownership-ledger metadata (memory observability plane) ----------
+    # Populated at add_owned / note_size time and ONLY read back by the
+    # pull-only GetMemoryReport path — nothing on the hot path consults it.
+    size: int = 0
+    created: float = 0.0
+    callsite: str = ""
+    task_id: Optional[bytes] = None  # task whose return this is (if any)
+    plasma: bool = False  # primary copy lives in the shared object store
 
 
 class ReferenceCounter:
@@ -49,10 +58,18 @@ class ReferenceCounter:
 
     # ---- owner side -------------------------------------------------------
 
-    def add_owned(self, object_id: ObjectID, lineage_task_id=None):
+    def add_owned(self, object_id: ObjectID, lineage_task_id=None, *,
+                  size: int = 0, callsite: str = "", task_id=None):
         with self._lock:
             ref = self._owned.setdefault(object_id, OwnedRef())
             ref.lineage_task_id = lineage_task_id
+            ref.created = time.time()
+            if size:
+                ref.size = size
+            if callsite:
+                ref.callsite = callsite
+            if task_id is not None:
+                ref.task_id = task_id
 
     def owns(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -146,3 +163,60 @@ class ReferenceCounter:
     def stats(self):
         with self._lock:
             return {"owned": len(self._owned), "borrowed": len(self._borrowed)}
+
+    # ---- ownership ledger (pull-only; memory observability plane) ---------
+
+    def note_size(self, object_id: ObjectID, size: int, plasma: bool = False):
+        """Record an owned ref's byte size once it becomes known (reply
+        landing, plasma registration). No-op for refs we no longer own."""
+        with self._lock:
+            ref = self._owned.get(object_id)
+            if ref is not None:
+                ref.size = size
+                if plasma:
+                    ref.plasma = True
+
+    def owns_many(self, ids) -> List[bool]:
+        """Batch ownership probe for the leak detector's CheckRefs RPC."""
+        with self._lock:
+            return [oid in self._owned for oid in ids]
+
+    def ledger(self, limit: int = 0) -> List[dict]:
+        """Snapshot of every owned ref's metadata, largest first.
+
+        This IS the per-worker object ownership ledger: per ref — size,
+        owning task, creation callsite, pin/plasma state, age, refcounts.
+        Built entirely on demand (the hot path only ever wrote the cheap
+        fields); ``limit`` > 0 keeps the top holders by size.
+        """
+        now = time.time()
+        with self._lock:
+            rows = [
+                {
+                    "object_id": oid.binary(),
+                    "size": ref.size,
+                    "age_s": round(now - ref.created, 3) if ref.created else 0.0,
+                    "callsite": ref.callsite,
+                    "task_id": ref.task_id or b"",
+                    "plasma": ref.plasma,
+                    "local_refs": ref.local_refs,
+                    "submitted_task_refs": ref.submitted_task_refs,
+                    "borrowers": len(ref.borrowers),
+                }
+                for oid, ref in self._owned.items()
+            ]
+        rows.sort(key=lambda r: -r["size"])
+        if limit and len(rows) > limit:
+            del rows[limit:]
+        return rows
+
+    def owned_bytes(self) -> Tuple[int, int]:
+        """(total owned bytes, of which plasma-resident) — cheap totals for
+        snapshots and rollups."""
+        with self._lock:
+            total = plasma = 0
+            for ref in self._owned.values():
+                total += ref.size
+                if ref.plasma:
+                    plasma += ref.size
+            return total, plasma

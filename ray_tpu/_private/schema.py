@@ -128,7 +128,9 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "CancelBundle": {"pg_id?": bytes, "bundle_index?": int},
     "ReturnBundle": {"pg_id?": bytes, "bundle_index?": int},
     "SpillObjects": {"bytes": int},
-    "PinObject": {"object_id": bytes, "owner_addr?": _addr},
+    # meta: ownership attribution (job/actor/task/callsite/size) kept for
+    # the leak detector and OOM forensics — see raylet _pin_meta handling
+    "PinObject": {"object_id": bytes, "owner_addr?": _addr, "meta?": dict},
     "FreeObjects": {"ids": list},
     "PushObject": {"object_id": bytes, "target": bytes,
                    "owner_addr?": (_addr, type(None))},
@@ -150,6 +152,9 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
                      "include_workers?": bool},
     "CollectProfile": {},
     "DumpFlightRecorder": {"limit?": int, "include_workers?": bool},
+    # sweep=True forces a leak sweep before replying (CLI --leaks path)
+    "GetMemoryReport": {"include_workers?": bool, "limit?": int,
+                        "sweep?": bool},
     "Ping": {},
 }
 
@@ -175,6 +180,8 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "Exit": {},
     "Ping": {},
     "GetCoreWorkerStats": {},
+    "GetMemoryReport": {"limit?": int},
+    "CheckRefs": {"ids": list},
 }
 
 

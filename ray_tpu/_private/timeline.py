@@ -151,6 +151,42 @@ def chrome_trace_events(events: List[dict]) -> List[dict]:
     return out
 
 
+_FLIGHT_INSTANTS = {
+    "obj.spill": "spill",
+    "obj.restore": "restore",
+    "obj.leak": "leak",
+}
+
+
+def flight_instant_events(node_hex: str, events: List[dict]) -> List[dict]:
+    """Render a raylet flight-recorder ring's object-plane events
+    (``obj.spill`` / ``obj.restore`` / ``obj.leak``) as Chrome instants on
+    the owning node's lane — recorded since PR 3 but invisible in
+    ``ray-tpu timeline`` until now. ``events`` is the formatted dump
+    (flight_recorder.dump / DumpFlightRecorder reply)."""
+    out: List[dict] = []
+    for ev in events:
+        name = _FLIGHT_INSTANTS.get(ev.get("event", ""))
+        if name is None:
+            continue
+        oid = ev.get("a", "")
+        out.append({
+            "cat": "object_store",
+            "name": f"obj.{name}",
+            "ph": "i",
+            "s": "t",
+            "ts": float(ev.get("ts", 0.0)) * 1e6,
+            "pid": f"node:{(node_hex or '?')[:8]}",
+            "tid": "object_store",
+            "args": {
+                "object_id": oid if isinstance(oid, str) else str(oid),
+                "bytes": ev.get("b", ""),
+                "event": ev.get("event", ""),
+            },
+        })
+    return out
+
+
 # ------------------------------------------------ profiling-plane merging
 
 

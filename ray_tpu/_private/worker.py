@@ -31,6 +31,7 @@ from ray_tpu._private.executor import Executor
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.gcs.client import GcsAioClient, GcsClient
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.memory_report import callsite as _mem_callsite
 from ray_tpu._private.memory_store import InPlasma, MemoryStore
 from ray_tpu._private.object_ref import ObjectRef, set_worker_hooks
 from ray_tpu._private.reference_counter import ReferenceCounter
@@ -347,6 +348,9 @@ class CoreWorker:
         # task_id -> (name, start wall time) while executing here
         # (maintained by TaskEventBuffer.record on RUNNING/terminal)
         self.running_tasks: Dict[bytes, tuple] = {}
+        # memory observability: periodic on-disk ledger snapshot throttle
+        self._mem_snapshot_period = RTPU_CONFIG.memory_snapshot_period_s
+        self._last_mem_snapshot = 0.0
 
         # Direct call channels (direct_channel.py): caller-side manager +
         # the actor-worker-side server behind a connection upgrade.
@@ -496,6 +500,25 @@ class CoreWorker:
             # this is what lets the raylet read a SIGKILLed worker's last
             # events — no exit handler ever runs for SIGKILL.
             _fr.flush_to_file()
+            # Same SIGKILL-safety for memory state: a compact ledger
+            # snapshot on disk is what OOM forensics attaches to this
+            # worker's death report if it dies without warning.
+            self._maybe_write_memory_snapshot()
+
+    def _maybe_write_memory_snapshot(self):
+        period = self._mem_snapshot_period
+        if period <= 0 or self.mode != MODE_WORKER or not self.session_dir:
+            return
+        now = time.time()
+        if now - self._last_mem_snapshot < period:
+            return
+        self._last_mem_snapshot = now
+        try:
+            from ray_tpu._private import memory_report as _mr
+
+            _mr.write_snapshot(self)
+        except Exception:
+            pass
 
     def _drain_stamped_user_metrics(self):
         """Drain ray_tpu.util.metrics records (if that module is in use),
@@ -824,7 +847,9 @@ class CoreWorker:
         oid = self._next_put_id()
         p, bufs, _refs = serialization.serialize(value)
         size = len(p) + serialization.buffers_nbytes(bufs)
-        self.refs.add_owned(oid)
+        self.refs.add_owned(
+            oid, size=size, callsite=_mem_callsite(),
+            task_id=self.current_task_id().binary())
         if size <= self.inline_threshold:
             payload = serialization.inline_payload(p, bufs)
             self.io.run(self._store_inline(oid, payload))
@@ -896,16 +921,42 @@ class CoreWorker:
         node = self.node_id.binary()
         self.memory_store.put(oid, InPlasma(size, {node}))
         self._object_locations.setdefault(oid.binary(), set()).add(node)
+        self.refs.note_size(oid, size, plasma=True)
         try:
             # Synchronous: until the pin lands, a concurrent put's evict()
             # could reclaim this primary and lose the object.
             await self.raylet.call(
                 "PinObject",
-                {"object_id": oid.binary(), "owner_addr": list(self.address)},
+                {"object_id": oid.binary(), "owner_addr": list(self.address),
+                 "meta": self._pin_meta(oid, size)},
                 timeout=30,
             )
         except Exception:
             pass
+
+    def _pin_meta(self, oid: ObjectID, size: int, spec: Optional[dict] = None) -> dict:
+        """Ownership attribution shipped with a PinObject so the raylet's
+        leak detector and OOM forensics can name the holder even after the
+        owner's ledger entry (or the owner itself) is gone."""
+        if spec is not None:
+            return {
+                "job_id": spec.get("job_id", b"") or b"",
+                "actor_id": spec.get("actor_id") or b"",
+                "task_id": spec.get("task_id", b"") or b"",
+                "callsite": "task:" + spec.get("name", ""),
+                "size": size,
+            }
+        with self.refs._lock:
+            ref = self.refs._owned.get(oid)
+            callsite = ref.callsite if ref else ""
+            task_id = (ref.task_id if ref else None) or b""
+        return {
+            "job_id": self.job_id.binary(),
+            "actor_id": self.actor_id or b"",
+            "task_id": task_id,
+            "callsite": callsite,
+            "size": size,
+        }
 
     # -- get ---------------------------------------------------------------
 
@@ -1319,7 +1370,9 @@ class CoreWorker:
         """put() for an already-serialized value: the raw buffer views go
         straight into plasma with no re-pickle and no bytes() copy."""
         oid = self._next_put_id()
-        self.refs.add_owned(oid)
+        self.refs.add_owned(
+            oid, callsite=_mem_callsite(),
+            task_id=self.current_task_id().binary())
         nbytes = self._plasma_put_payload(oid, pickle_bytes, buffers)
         self.io.run(self._register_plasma_primary(oid, nbytes))
         return ObjectRef(oid, self.address)
@@ -1347,8 +1400,14 @@ class CoreWorker:
     def _register_pending(self, spec: dict, arg_refs: List[ObjectRef]) -> List[ObjectRef]:
         return_ids = ts.return_object_ids(spec)
         out = []
+        # ledger attribution for task returns: the submitting task owns
+        # them; the "callsite" is the task name (cheap — no frame walk on
+        # the submit hot path).
+        ret_callsite = "task:" + spec.get("name", "")
         for oid in return_ids:
-            self.refs.add_owned(oid, lineage_task_id=spec["task_id"])
+            self.refs.add_owned(oid, lineage_task_id=spec["task_id"],
+                                callsite=ret_callsite,
+                                task_id=spec["task_id"])
         # Direct call, not io.run: a cross-thread round-trip here costs ~1 ms
         # per .remote() and caps submission at <1k tasks/s. put_pending only
         # creates dict entries + an (unbound) asyncio.Event — safe under the
@@ -1693,6 +1752,7 @@ class CoreWorker:
                     any_plasma = True
                     self.memory_store.put(oid, InPlasma(meta["size"], {meta["node_id"]}))
                     self._object_locations.setdefault(oid.binary(), set()).add(meta["node_id"])
+                    self.refs.note_size(oid, meta["size"], plasma=True)
             if any_plasma:
                 self._store_lineage(spec)
         self._pending_tasks.pop(spec["task_id"], None)
@@ -2221,7 +2281,8 @@ class CoreWorker:
         try:
             await self.raylet.call(
                 "PinObject",
-                {"object_id": oid.binary(), "owner_addr": list(spec["owner_addr"])},
+                {"object_id": oid.binary(), "owner_addr": list(spec["owner_addr"]),
+                 "meta": self._pin_meta(oid, size, spec=spec)},
                 timeout=30,
             )
         except Exception:
@@ -2458,6 +2519,23 @@ class CoreWorker:
                 for tid, (name, t0) in list(self.running_tasks.items())
             ],
         }
+
+    async def handle_GetMemoryReport(self, req):
+        """Memory observability plane: this process's object ownership
+        ledger + RSS. Pull-only — the ledger snapshot is built here, on
+        demand, from fields the hot paths already maintain (the raylet
+        fans this out per node; util.state aggregates the cluster)."""
+        from ray_tpu._private import memory_report as _mr
+
+        limit = req.get("limit") or RTPU_CONFIG.memory_report_top_n
+        return {"report": _mr.build_worker_report(self, limit=limit)}
+
+    async def handle_CheckRefs(self, req):
+        """Leak-detector probe: which of ``ids`` does this process still
+        own (a live entry in its reference counter)? A pinned plasma
+        primary whose owner answers False here — twice — is a leak."""
+        ids = [ObjectID(b) for b in req.get("ids", [])]
+        return {"owned": self.refs.owns_many(ids)}
 
     # ------------------------------------------------------------- shutdown
 

@@ -13,6 +13,10 @@ Routes:
   GET  /api/profile                cluster-wide CPU capture (merged trace;
                                    ?format=flame folded, ?latest=1 registry,
                                    ?pid=/?worker_id= one-worker folded)
+  GET  /api/memory                 cluster memory report (plasma + RSS +
+                                   HBM rollups, ownership ledgers;
+                                   ?group_by=job|actor|node, ?leaks=1
+                                   runs the leak detector)
   GET  /api/perf                   perf-gate ledger + latest delta report
                                    (?metric= one metric's trajectory,
                                    ?limit=N history depth)
@@ -98,6 +102,8 @@ class DashboardHead:
             return self._profile_api(query or {})
         if path == "/api/perf":
             return self._perf_api(query or {})
+        if path == "/api/memory":
+            return self._memory_api(query or {})
         if path == "/api/node_stats":
             return self._node_stats_api(query or {})
         if path == "/api/agent_metrics":
@@ -226,6 +232,33 @@ class DashboardHead:
             task_events = []
         device = profiling.list_registered(gcs, "device_trace")
         return 200, merged_profile_trace(bundle, task_events, device)
+
+    def _memory_api(self, query):
+        """GET /api/memory: the memory observability plane over HTTP —
+        the cluster memory report (per-node plasma/pin/spill state joined
+        with worker ownership ledgers) plus a rollup.
+        ``?group_by=job|actor|node`` picks the rollup key (default job);
+        ``?leaks=1`` forces a leak sweep and returns the findings;
+        ``?objects=0`` drops the per-object listings (cheap summary)."""
+        state = self._state()
+        addr = self.gcs_address
+        group_by = query.get("group_by") or "job"
+        if group_by not in ("job", "actor", "node"):
+            return 400, {"error": f"bad group_by {group_by!r}"}
+        try:
+            if query.get("leaks"):
+                return 200, {
+                    "leaks": state.find_memory_leaks(addr, sweep=True)}
+            include_objects = query.get("objects", "1") not in ("0", "false")
+            report = state.memory_report(
+                addr, include_objects=include_objects)
+            report["rollup"] = {
+                "group_by": group_by,
+                "rows": state.memory_rollup(report, group_by=group_by),
+            }
+            return 200, report
+        except Exception as e:
+            return 500, {"error": str(e)}
 
     def _perf_api(self, query):
         """GET /api/perf: the perf regression plane over HTTP — the ledger

@@ -11,9 +11,16 @@ Commands:
   status   [--address]
   nodes    [--address]
   actors   [--address]
-  memory   [--address]           object-store usage per node
+  memory   [--address] [--group-by job|actor|node] [--leaks]
+           [--sort-by size|plasma|rss|objects]
+                                 cluster memory report: per-node object
+                                 store, rollups unifying plasma/RSS/HBM,
+                                 top owned objects w/ callsites; --leaks
+                                 runs the leak detector w/ attribution
   timeline [--address] [--job HEX] [--trace-id ID] -o FILE
-                                 Chrome-trace dump (filters server-side)
+                                 Chrome-trace dump (filters server-side;
+                                 spill/restore/leak instants fanned in
+                                 from raylet flight rings)
   profile  [--address] [--duration S] [--hz N] [--node HEX] [-o FILE]
                                  cluster-wide CPU capture merged with the
                                  task timeline (Perfetto JSON); --flame for
@@ -200,6 +207,36 @@ def cmd_status(args):
     print("resources:")
     for k in sorted(res["total"]):
         print(f"  {res['available'].get(k, 0):.1f}/{res['total'][k]:.1f} {k}")
+    # Memory visibility without running `memory`: per-node object-store
+    # utilization + the top-consuming job, from the same aggregation path.
+    try:
+        from ray_tpu.util import state as _state
+
+        report = _state.memory_report(addr, include_objects=True,
+                                      include_drivers=False)
+        print("object store:")
+        for node in report["nodes"]:
+            s = node.get("plasma", {})
+            cap = s.get("capacity_bytes") or 0
+            used = s.get("used_bytes") or 0
+            pct = f" ({100.0 * used / cap:.0f}%)" if cap else ""
+            print(f"  {node['node_id'][:12]}: {_fmt_bytes(used)}/"
+                  f"{_fmt_bytes(cap)}{pct} used, "
+                  f"{_fmt_bytes(node['pinned_bytes'])} pinned"
+                  + (f", {len(node['leaks'])} leaked objects"
+                     if node.get("leaks") else ""))
+        rollup = _state.memory_rollup(report, group_by="job")
+        rollup.pop("?", None)
+        if rollup:
+            top_job, r = max(
+                rollup.items(),
+                key=lambda kv: kv[1]["plasma_bytes"] + kv[1]["rss_bytes"])
+            print(f"  top job: {top_job[:12]} — "
+                  f"{_fmt_bytes(r['plasma_bytes'])} plasma, "
+                  f"{_fmt_bytes(r['rss_bytes'])} rss, "
+                  f"{r['objects']} objects")
+    except Exception:
+        print("object store: unavailable")
     # Stall visibility without running `debug`: the watchdogs publish
     # incidents to the GCS; a non-zero count here is the first hint.
     try:
@@ -231,21 +268,98 @@ def cmd_actors(args):
         print(f"{a['actor_id'][:12]} {a['state']:<8} name={name}")
 
 
+def _fmt_bytes(n) -> str:
+    from ray_tpu._private.memory_report import _fmt_bytes as f
+
+    return f(n)
+
+
 def cmd_memory(args):
+    """Memory observability plane: per-node object-store state, per-group
+    rollups (job/actor/node) unifying plasma + RSS + HBM, the largest
+    owned objects with creation callsites, and (--leaks) the leak
+    detector's findings with attribution."""
     from ray_tpu.util import state
 
-    objs = state.list_objects(_resolve_address(args))
-    by_node = {}
-    for o in objs:
-        st = by_node.setdefault(o["node_id"], {"n": 0, "bytes": 0, "spilled": 0})
-        st["n"] += 1
-        st["bytes"] += o.get("size_bytes") or 0
-        st["spilled"] += 1 if o.get("spilled") else 0
-    for node, st in by_node.items():
-        print(f"{node[:12]}: {st['n']} objects, {st['bytes']} bytes, "
-              f"{st['spilled']} spilled")
-    if not by_node:
-        print("no objects")
+    addr = _resolve_address(args)
+    group_by = getattr(args, "group_by", "job") or "job"
+    sort_by = getattr(args, "sort_by", "size") or "size"
+
+    if getattr(args, "leaks", False):
+        leaks = state.find_memory_leaks(addr, sweep=True)
+        if not leaks:
+            print("no leaked objects detected "
+                  "(pinned primaries all have live owner references)")
+            return
+        print(f"{len(leaks)} leaked object(s), "
+              f"{_fmt_bytes(sum(l.get('size') or 0 for l in leaks))} total:")
+        for l in leaks:
+            where = f" @ {l['callsite']}" if l.get("callsite") else ""
+            owner = (f" actor={l['actor_id'][:12]}" if l.get("actor_id")
+                     else "")
+            print(f"  {l['object_id'][:12]} {_fmt_bytes(l.get('size'))} "
+                  f"node={l['node_id'][:12]} job={l['job_id'][:12] or '?'}"
+                  f"{owner}{where}"
+                  + (" [spilled]" if l.get("spilled") else ""))
+        print("details: `ray-tpu debug incidents` (kind=object_leak)")
+        return
+
+    report = state.memory_report(addr)
+    for node in report["nodes"]:
+        s = node.get("plasma", {})
+        cap = s.get("capacity_bytes") or 0
+        used = s.get("used_bytes") or 0
+        pct = f" ({100.0 * used / cap:.0f}%)" if cap else ""
+        leak_note = (f", {len(node['leaks'])} LEAKED"
+                     if node.get("leaks") else "")
+        print(f"node {node['node_id'][:12]}: object store "
+              f"{_fmt_bytes(used)}/{_fmt_bytes(cap)}{pct}, "
+              f"{node['pinned_count']} pinned "
+              f"({_fmt_bytes(node['pinned_bytes'])}), "
+              f"{node['spilled_count']} spilled "
+              f"({_fmt_bytes(node['spilled_bytes'])}), "
+              f"raylet rss {_fmt_bytes(node['raylet_rss'])}{leak_note}")
+    rollup = state.memory_rollup(report, group_by=group_by)
+    sort_key = {
+        "size": lambda kv: -(kv[1]["plasma_bytes"] + kv[1]["rss_bytes"]),
+        "plasma": lambda kv: -kv[1]["plasma_bytes"],
+        "rss": lambda kv: -kv[1]["rss_bytes"],
+        "objects": lambda kv: -kv[1]["objects"],
+    }.get(sort_by, lambda kv: -(kv[1]["plasma_bytes"] + kv[1]["rss_bytes"]))
+    if rollup:
+        print(f"\nby {group_by}:")
+        hdr = (f"  {'key':<14} {'plasma':>10} {'objects':>8} "
+               f"{'spilled':>10} {'rss':>10} {'hbm':>10} {'leaked':>10}")
+        print(hdr)
+        for key, r in sorted(rollup.items(), key=sort_key):
+            print(f"  {key[:14]:<14} {_fmt_bytes(r['plasma_bytes']):>10} "
+                  f"{r['objects']:>8} {_fmt_bytes(r['spilled_bytes']):>10} "
+                  f"{_fmt_bytes(r['rss_bytes']):>10} "
+                  f"{_fmt_bytes(r['hbm_bytes']):>10} "
+                  f"{_fmt_bytes(r['leaked_bytes']):>10}")
+    # top holders across every ledger, largest first
+    holders = []
+    for node in report["nodes"]:
+        for w in node["workers"]:
+            for row in w.get("ledger", []):
+                holders.append((row, w))
+    for w in report.get("drivers", []):
+        for row in w.get("ledger", []):
+            holders.append((row, w))
+    holders.sort(key=lambda t: -(t[0].get("size") or 0))
+    shown = [h for h in holders[:10] if (h[0].get("size") or 0) > 0]
+    if shown:
+        print("\ntop owned objects:")
+        for row, w in shown:
+            owner = (f"actor {w['actor_id'][:12]}" if w.get("actor_id")
+                     else w.get("mode", "worker"))
+            where = row.get("callsite") or "?"
+            print(f"  {row['object_id'][:12]} {_fmt_bytes(row['size']):>10} "
+                  f"age={row.get('age_s', 0):.0f}s "
+                  f"{'plasma ' if row.get('plasma') else ''}"
+                  f"owner={owner} @ {where}")
+    if not report["nodes"]:
+        print("no alive nodes")
 
 
 def cmd_profile(args):
@@ -345,15 +459,34 @@ def cmd_grafana(args):
 
 def cmd_timeline(args):
     from ray_tpu._private.gcs.client import GcsClient
-    from ray_tpu._private.timeline import chrome_trace_events
+    from ray_tpu._private.timeline import (
+        chrome_trace_events, flight_instant_events)
 
-    gcs = GcsClient.from_address(_resolve_address(args))
+    addr = _resolve_address(args)
+    gcs = GcsClient.from_address(addr)
     req = {"limit": 100_000}
     if getattr(args, "job", None):
         req["job_id"] = args.job
     if getattr(args, "trace_id", None):
         req["trace_id"] = args.trace_id
     events = chrome_trace_events(gcs.call("GetTaskEvents", req)["events"])
+    # Object-plane instants (spill/restore/leak) live in the raylets'
+    # flight-recorder rings, not the GCS task-event log — fan them in so
+    # "the step stalled while the store was spilling" is one view.
+    if not getattr(args, "no_object_events", False):
+        from ray_tpu.util import state
+
+        try:
+            for n, reply in state._fanout_raylets(
+                addr, "DumpFlightRecorder", timeout=15,
+                payload={"include_workers": False},
+            ):
+                events.extend(flight_instant_events(
+                    n["node_id"].hex(), reply.get("events", [])))
+        except Exception as e:
+            print(f"warning: object-event fan-in failed: {e}",
+                  file=sys.stderr)
+        events.sort(key=lambda e: e["ts"])
     with open(args.output, "w") as f:
         json.dump(events, f)
     print(f"wrote {len(events)} events to {args.output}")
@@ -454,6 +587,20 @@ def collect_debug_dump(address: str, *, ring_limit: int = 1000,
     for n, reply in state._fanout_raylets(address, "GetNodeInfo", timeout=15):
         node = n["node_id"].hex()[:12]
         put_json(f"nodes/node_{node}.json", reply)
+    # 5b. memory plane: per-node memory reports (plasma/pin/spill tables
+    #     joined with worker ownership ledgers) + the cluster rollup —
+    #     the "who was holding what" half of a hang/OOM post-mortem
+    try:
+        report = state.memory_report(address)
+        for node in report["nodes"]:
+            put_json(f"memory/node_{node['node_id'][:12]}.json", node)
+        put_json("memory/rollup.json", {
+            gb: state.memory_rollup(report, group_by=gb)
+            for gb in ("job", "actor", "node")
+        })
+        put_json("memory/drivers.json", report.get("drivers", []))
+    except Exception as e:
+        files["memory/rollup.json"] = json.dumps({"error": str(e)})
     for n, reply in state._fanout_raylets(
         address, "GetLocalWorkerInfo", timeout=15
     ):
@@ -642,10 +789,26 @@ def main(argv=None):
     p.set_defaults(fn=cmd_down)
 
     for name, fn in (("status", cmd_status), ("nodes", cmd_nodes),
-                     ("actors", cmd_actors), ("memory", cmd_memory)):
+                     ("actors", cmd_actors)):
         p = sub.add_parser(name)
         p.add_argument("--address", default=None)
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "memory",
+        help="cluster memory report: object-store state per node, "
+             "job/actor/node rollups (plasma+RSS+HBM), top owned objects "
+             "with callsites; --leaks runs the leak detector")
+    p.add_argument("--address", default=None)
+    p.add_argument("--group-by", dest="group_by", default="job",
+                   choices=("job", "actor", "node"))
+    p.add_argument("--sort-by", dest="sort_by", default="size",
+                   choices=("size", "plasma", "rss", "objects"))
+    p.add_argument("--leaks", action="store_true",
+                   help="force a leak sweep on every node and list "
+                        "pinned/spilled primaries with no live owner "
+                        "reference (with job/actor/callsite attribution)")
+    p.set_defaults(fn=cmd_memory)
 
     p = sub.add_parser("timeline")
     p.add_argument("--address", default=None)
@@ -653,6 +816,10 @@ def main(argv=None):
                    help="only this job's events (hex id, server-side)")
     p.add_argument("--trace-id", dest="trace_id", default=None,
                    help="only this trace's spans (server-side)")
+    p.add_argument("--no-object-events", dest="no_object_events",
+                   action="store_true",
+                   help="skip the spill/restore/leak instants fanned in "
+                        "from the raylets' flight recorders")
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
 

@@ -48,9 +48,23 @@ change (add new series instead). The stable set:
     ray_tpu_perf_compile_storms_total  counter — jit_cache_miss_storm
                                        incidents raised by the watchdog
 
-The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* config flags are
-likewise a stability contract — see the profiling-plane and
-perf-regression-plane sections of ``ray_tpu/_private/config.py``.
+  memory observability plane (raylet _collect_metrics, labels: node)
+    ray_tpu_object_store_pinned_bytes  gauge — bytes held by pinned
+                                       primary copies in this node's
+                                       plasma store
+    ray_tpu_object_store_leaked_bytes  gauge — bytes in primaries the
+                                       leak detector confirmed have no
+                                       live owner reference (two-sweep
+                                       cross-check)
+    ray_tpu_memory_rss_bytes           gauge, labels +role
+                                       (raylet|worker|agent) — resident
+                                       set size per process role on the
+                                       node (worker = sum over workers)
+
+The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* /
+RTPU_memory_* config flags are likewise a stability contract — see the
+profiling-plane, perf-regression-plane and memory-observability-plane
+sections of ``ray_tpu/_private/config.py``.
 """
 
 from __future__ import annotations

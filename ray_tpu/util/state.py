@@ -276,6 +276,237 @@ def list_workers(
     return _filtered(out, filters)[:limit]
 
 
+# ------------------------------------------------- memory observability
+
+
+def _hexify_worker_report(w: dict) -> dict:
+    out = dict(w)
+    for k in ("worker_id", "actor_id", "job_id"):
+        out[k] = _hex(out.get(k, b"") or b"")
+    out["ledger"] = [
+        {**row,
+         "object_id": _hex(row.get("object_id", b"")),
+         "task_id": _hex(row.get("task_id", b"") or b"")}
+        for row in (w.get("ledger") or [])
+    ]
+    return out
+
+
+def _driver_memory_reports(address: Optional[str], limit: int) -> List[dict]:
+    """Drivers own most long-lived refs but live in no raylet's worker
+    pool — ask each RUNNING job's driver directly (same pattern as the
+    profiling plane's driver fan-out)."""
+    import asyncio
+
+    from ray_tpu._private.rpc import IoThread, RpcClient
+
+    addrs = []
+    try:
+        for j in _gcs(address).call("GetAllJobInfo", {}, timeout=10)["jobs"]:
+            addr = j.get("driver_addr")
+            if j.get("state") == "RUNNING" and addr and addr[1]:
+                addrs.append((addr[0], int(addr[1])))
+    except Exception:
+        return []
+
+    async def _one(a):
+        client = RpcClient(*a)
+        try:
+            await client.connect()
+            r = await client.call("GetMemoryReport", {"limit": limit},
+                                  timeout=10)
+            return r.get("report")
+        finally:
+            await client.close()
+
+    async def _all():
+        return await asyncio.gather(*(_one(a) for a in addrs),
+                                    return_exceptions=True)
+
+    results = IoThread.current().run(_all(), timeout=30)
+    return [_hexify_worker_report(r) for r in results
+            if r and not isinstance(r, BaseException)]
+
+
+def memory_report(
+    address: Optional[str] = None, *, include_objects: bool = True,
+    include_drivers: bool = True, sweep: bool = False,
+    limit: int = 0,
+) -> dict:
+    """Cluster-wide memory report: every raylet's plasma/spill/pin tables
+    joined with its workers' object ownership ledgers (``GetMemoryReport``
+    fan-in), running jobs' driver ledgers, and the per-device HBM gauges
+    the train telemetry already exports — one structure that answers "who
+    is holding this memory". ``sweep=True`` forces a leak sweep on every
+    node first."""
+    import time as _time
+
+    from ray_tpu._private.config import RTPU_CONFIG
+
+    limit = limit or RTPU_CONFIG.memory_report_top_n
+    payload = {"include_workers": True, "limit": limit}
+    if sweep:
+        payload["sweep"] = True
+    nodes_out = []
+    for n, r in _fanout_raylets(
+        address, "GetMemoryReport", timeout=60, payload=payload
+    ):
+        node = {
+            "node_id": _hex(r.get("node_id", n["node_id"])),
+            "node_ip": n["ip"],
+            "plasma": r.get("plasma", {}),
+            "pinned_count": r.get("pinned_count", 0),
+            "pinned_bytes": r.get("pinned_bytes", 0),
+            "spilled_count": r.get("spilled_count", 0),
+            "spilled_bytes": r.get("spilled_bytes", 0),
+            "raylet_rss": r.get("raylet_rss", 0),
+            "agent_rss": r.get("agent_rss", 0),
+            "leaks": r.get("leaks", []),
+            "leak_candidates": r.get("leak_candidates", 0),
+            "workers": [_hexify_worker_report(w)
+                        for w in r.get("workers", [])],
+        }
+        if include_objects:
+            node["objects"] = [
+                {**o,
+                 "object_id": _hex(o.get("object_id", b"")),
+                 "job_id": _hex(o.get("job_id", b"") or b""),
+                 "actor_id": _hex(o.get("actor_id", b"") or b""),
+                 "task_id": _hex(o.get("task_id", b"") or b"")}
+                for o in r.get("objects", [])
+            ]
+        else:
+            node["objects"] = []
+        nodes_out.append(node)
+    drivers = (_driver_memory_reports(address, limit)
+               if include_drivers else [])
+    try:
+        hbm = _gcs(address).call(
+            "GetUserMetrics",
+            {"prefix": "ray_tpu_train_hbm_bytes_in_use"})["records"]
+    except Exception:
+        hbm = []
+    return {"time": _time.time(), "nodes": nodes_out, "drivers": drivers,
+            "hbm": hbm}
+
+
+def memory_rollup(report: dict, group_by: str = "job") -> Dict[str, dict]:
+    """Fold a ``memory_report`` into per-job / per-actor / per-node rows
+    unifying plasma residency (raylet tables, pin-meta attribution), worker
+    RSS + owned-ledger bytes, per-device HBM, and leaked bytes."""
+    if group_by not in ("job", "actor", "node"):
+        raise ValueError(f"group_by must be job|actor|node, not {group_by!r}")
+    rows: Dict[str, dict] = {}
+
+    def row(key: str) -> dict:
+        return rows.setdefault(key or "?", {
+            "plasma_bytes": 0, "objects": 0, "spilled_bytes": 0,
+            "rss_bytes": 0, "owned_bytes": 0, "hbm_bytes": 0,
+            "leaked_bytes": 0, "workers": 0,
+        })
+
+    # WorkerId metric labels are 12-hex prefixes (worker.py stamps them)
+    wid_map: Dict[str, str] = {}
+    # object_id -> (job, actor) from every owner ledger: attributes copies
+    # that carry no pin meta (e.g. secondaries pulled to another node)
+    oid_attr: Dict[str, tuple] = {}
+    for node in report.get("nodes", []):
+        for w in node.get("workers", []):
+            wid = (w.get("worker_id") or "")[:12]
+            if group_by == "node":
+                wid_map[wid] = node["node_id"]
+            elif group_by == "actor":
+                wid_map[wid] = w.get("actor_id") or "-"
+            else:
+                wid_map[wid] = w.get("job_id") or "?"
+            for entry in w.get("ledger") or []:
+                oid_attr[entry.get("object_id", "")] = (
+                    w.get("job_id") or "", w.get("actor_id") or "")
+    for w in report.get("drivers", []):
+        wid_map[(w.get("worker_id") or "")[:12]] = (
+            "(driver)" if group_by in ("node", "actor")
+            else w.get("job_id") or "?")
+        for entry in w.get("ledger") or []:
+            oid_attr[entry.get("object_id", "")] = (
+                w.get("job_id") or "", w.get("actor_id") or "")
+
+    def _obj_key(node: dict, o: dict) -> str:
+        if group_by == "node":
+            return node["node_id"]
+        attr = oid_attr.get(o.get("object_id", ""), ("", ""))
+        if group_by == "actor":
+            return o.get("actor_id") or attr[1] or "-"
+        return o.get("job_id") or attr[0] or "?"
+
+    for node in report.get("nodes", []):
+        for o in node.get("objects", []):
+            r = row(_obj_key(node, o))
+            r["objects"] += 1
+            size = o.get("size") or 0
+            if o.get("spilled") and not o.get("pinned"):
+                r["spilled_bytes"] += size
+            else:
+                r["plasma_bytes"] += size
+        for leak in node.get("leaks", []):
+            r = row(_obj_key(node, leak))
+            r["leaked_bytes"] += leak.get("size") or 0
+        for w in node.get("workers", []):
+            if group_by == "node":
+                key = node["node_id"]
+            elif group_by == "actor":
+                key = w.get("actor_id") or "-"
+            else:
+                key = w.get("job_id") or "?"
+            r = row(key)
+            r["rss_bytes"] += w.get("rss_bytes") or 0
+            r["owned_bytes"] += w.get("owned_bytes") or 0
+            r["workers"] += 1
+    for w in report.get("drivers", []):
+        if group_by == "job":
+            key = w.get("job_id") or "?"
+        else:
+            key = "(driver)"
+        r = row(key)
+        r["rss_bytes"] += w.get("rss_bytes") or 0
+        r["owned_bytes"] += w.get("owned_bytes") or 0
+        r["workers"] += 1
+    for rec in report.get("hbm", []):
+        labels = rec.get("labels", {})
+        if group_by == "job":
+            key = labels.get("JobId") or "?"
+        else:
+            key = wid_map.get(labels.get("WorkerId", ""), "?")
+        row(key)["hbm_bytes"] += rec.get("value") or 0
+    return rows
+
+
+def find_memory_leaks(
+    address: Optional[str] = None, *, sweep: bool = True,
+    confirm_pause_s: float = 1.0,
+) -> List[dict]:
+    """Leaked plasma primaries across the cluster, with attribution.
+
+    With ``sweep=True`` every raylet runs a leak sweep on demand — twice,
+    ``confirm_pause_s`` apart, because confirmation needs two sweeps (the
+    in-flight-handoff guard). Without it, returns whatever the background
+    cadence last confirmed."""
+    payload = {"include_workers": False}
+    if sweep:
+        payload["sweep"] = True
+        import time as _time
+
+        _fanout_raylets(address, "GetMemoryReport", timeout=60,
+                        payload=payload)
+        _time.sleep(max(0.0, confirm_pause_s))
+    leaks: List[dict] = []
+    for _n, r in _fanout_raylets(
+        address, "GetMemoryReport", timeout=60, payload=payload
+    ):
+        leaks.extend(r.get("leaks", []))
+    leaks.sort(key=lambda l: -(l.get("size") or 0))
+    return leaks
+
+
 def _filtered(rows: List[dict], filters) -> List[dict]:
     """filters: iterable of (key, predicate '=' or '!=', value) tuples."""
     if not filters:
