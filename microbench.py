@@ -87,6 +87,113 @@ def last_run_detail() -> dict:
     }
 
 
+def _bench_serve_llm(quick: bool, reps: int) -> dict:
+    """serve/llm CPU-plane load test: the continuous-batching engine vs the
+    same model (gpt2-tiny adapter, identical prompts/sampling) behind
+    static request batching — groups of max_batch admitted together and run
+    to completion before the next group, i.e. ``@serve.batch`` semantics at
+    the request level. Both sides share the engine, cache and adapter; only
+    the admission policy differs, so the ratio isolates iteration-level
+    scheduling. Full mode runs >= 1k concurrent streams (the ROADMAP item 1
+    acceptance scale); per-stream completion latency feeds the p99 metric
+    (lower is better — the perf gate knows, see
+    _private/perf_gate._LOWER_IS_BETTER).
+    """
+    import time as _time
+
+    from ray_tpu.serve.llm.adapters import build_adapter
+    from ray_tpu.serve.llm.engine import LLMEngine, SamplingParams
+
+    n_streams = 256 if quick else 1024
+    max_batch = 32
+    adapter = build_adapter(
+        "gpt2-tiny",
+        {"n_layer": 2, "n_embd": 64, "n_head": 4, "vocab_size": 512,
+         "block_size": 256, "use_flash_attention": False},
+        seed=0)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, int(rng.integers(4, 17))).tolist()
+               for _ in range(n_streams)]
+    # varied lengths: the continuous win comes from refilling the slots
+    # short requests free — uniform lengths would understate it
+    max_toks = rng.integers(4, 33, n_streams)
+    total_tokens = int(max_toks.sum())
+
+    def make_engine():
+        return LLMEngine(adapter, num_blocks=4096, block_size=16,
+                         max_batch=max_batch, max_waiting=n_streams + 1)
+
+    def run_continuous():
+        eng = make_engine()
+        t0 = _time.perf_counter()
+        rids = [eng.submit(p, SamplingParams(max_tokens=int(m)))
+                for p, m in zip(prompts, max_toks)]
+        idx = {r: i for i, r in enumerate(rids)}
+        done_at = np.zeros(n_streams)
+        while eng.has_work():
+            st = eng.step()
+            now = _time.perf_counter() - t0
+            for r in st.get("finished_ids", ()):
+                done_at[idx[r]] = now
+        dt = _time.perf_counter() - t0
+        return total_tokens / dt, float(np.percentile(done_at, 99) * 1000)
+
+    def run_static():
+        # Faithful @serve.batch inference: fixed-shape groups of max_batch,
+        # every decode step computes the FULL padded batch (finished rows
+        # included — compiled static shapes can't shrink), and the group
+        # holds its slots until the longest member finishes. Dense
+        # contiguous KV, no paging overhead — generous to this side.
+        t0 = _time.perf_counter()
+        for i in range(0, n_streams, max_batch):
+            gp = prompts[i:i + max_batch]
+            gm = max_toks[i:i + max_batch]
+            B = len(gp)
+            lens = np.asarray([len(p) for p in gp], dtype=np.int32)
+            steps = int(gm.max())
+            tmax = int(lens.max()) + steps
+            L, H, D = (adapter.n_layers, adapter.n_kv_heads,
+                       adapter.head_dim)
+            k_ctx = np.zeros((B, L, tmax, H, D), dtype=np.float32)
+            v_ctx = np.zeros_like(k_ctx)
+            toks = np.zeros(B, dtype=np.int64)
+            for j, p in enumerate(gp):
+                logits, k, v = adapter.prefill(np.asarray(p))
+                k_ctx[j, :, :lens[j]] = k
+                v_ctx[j, :, :lens[j]] = v
+                toks[j] = int(np.argmax(logits))
+            for _ in range(steps - 1):
+                logits, k_new, v_new = adapter.decode(
+                    toks, lens.astype(np.int64), k_ctx, v_ctx, lens)
+                for j in range(B):
+                    k_ctx[j, :, lens[j]] = k_new[j]
+                    v_ctx[j, :, lens[j]] = v_new[j]
+                lens = lens + 1
+                toks = np.argmax(logits, axis=-1)
+        return total_tokens / (_time.perf_counter() - t0)
+
+    cont, p99, stat = [], [], []
+    for _ in range(reps):
+        c, p = run_continuous()
+        cont.append(c)
+        p99.append(p)
+        stat.append(run_static())
+    out = {}
+    for key, vals in (("serve_llm_tokens_per_s", cont),
+                      ("serve_llm_static_batch_tokens_per_s", stat),
+                      ("serve_llm_stream_p99_ms", p99)):
+        vals = sorted(vals)
+        med = vals[len(vals) // 2]
+        _REP_DETAIL[key] = {"min": vals[0], "median": med, "max": vals[-1],
+                            "reps": reps}
+        out[key] = med
+        print(f"  {key}: {med:,.1f}")
+    print(f"  serve_llm continuous/static ratio: "
+          f"{out['serve_llm_tokens_per_s'] / out['serve_llm_static_batch_tokens_per_s']:.2f} "
+          f"({n_streams} streams)")
+    return out
+
+
 def _define_remotes():
     import ray_tpu
 
@@ -148,6 +255,24 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
 
     def sel(metric: str) -> bool:
         return not parts or any(p in metric for p in parts)
+
+    # serve/llm engine A/B runs in-process (no cluster): the CI row
+    # `--only serve_llm` answers "did continuous batching regress?" without
+    # paying a cluster boot
+    if (sel("serve_llm_tokens_per_s")
+            or sel("serve_llm_static_batch_tokens_per_s")
+            or sel("serve_llm_stream_p99_ms")):
+        results.update(_bench_serve_llm(quick, reps=_REPS))
+    cluster_metrics = (
+        "single_client_tasks_sync", "single_client_tasks_async",
+        "wait_1k_refs", "multi_client_tasks_async", "1_1_actor_calls_sync",
+        "1_1_actor_calls_async", "1_1_async_actor_calls_async",
+        "n_n_actor_calls_async", "single_client_put_calls",
+        "single_client_put_gigabytes", "single_client_get_calls_plasma",
+        "placement_group_create_removal",
+    )
+    if not any(sel(m) for m in cluster_metrics):
+        return {k: round(v, 1) for k, v in results.items()}
 
     ray_tpu.init(num_cpus=8)
     try:
@@ -378,6 +503,7 @@ def main():
         "| n_n_actor_calls_async | ±50% | ±35% | processes timeshare one core |",
         "| single_client_put_gigabytes | ±45% | ±30% | store page-fault state (cold ~2.1 vs steady 6.7 GiB/s) |",
         "| wait_1k_refs | ±45% | ±30% | timer batching across the submit window |",
+        "| serve_llm_* | ±45% | ±30% | multi-second numpy run: allocator/GC state; p99 row is LOWER-is-better (gate inverts) |",
         "",
         "The committed trajectory lives in `PERF_HISTORY.jsonl` (append with",
         "`ray-tpu perf check --update` when refreshing this table);",

@@ -193,6 +193,27 @@ _FLAGS: Dict[str, Any] = {
     "memory_leak_sweep_period_s": 60.0,
     "memory_leak_min_age_s": 30.0,
     "memory_leak_cooldown_s": 300.0,
+    # --- serve.llm continuous-batching engine (stability contract) ----------
+    # Same contract as the profiling/perf/memory flags above: operators size
+    # replicas with these (README "Serving an LLM"); renaming any is a
+    # breaking change — add new flags instead.
+    #   llm_block_size        tokens per paged-KV block; admission cost is
+    #                         ceil(prompt/block_size) blocks
+    #   llm_num_blocks        KV pool size per replica (blocks); with
+    #                         block_size 16 the default holds 16k tokens
+    #   llm_max_batch         max sequences per fused engine step (prefill
+    #                         admits only into spare slots)
+    #   llm_max_waiting       admission control: past this many queued
+    #                         prompts, submits are shed with a structured
+    #                         LLMBackpressure error instead of OOMing the
+    #                         cache
+    #   llm_pull_wait_s       long-poll window of a token pull (the stream
+    #                         ingress re-pulls after an empty reply)
+    "llm_block_size": 16,
+    "llm_num_blocks": 1024,
+    "llm_max_batch": 32,
+    "llm_max_waiting": 512,
+    "llm_pull_wait_s": 2.0,
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

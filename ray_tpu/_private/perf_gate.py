@@ -15,7 +15,9 @@ The noise bands below encode exactly that: a comparison's band is picked by
 the LEAST reliable side (min reps of baseline and current), then scaled by
 ``RTPU_perf_band_scale`` for noisier boxes. A drop beyond the band is a
 regression; a rise beyond it is flagged as an improvement (so a suspicious
-2x "win" is visible too, not just losses).
+2x "win" is visible too, not just losses). Latency-style rows
+(``_LOWER_IS_BETTER``, e.g. ``serve_llm_stream_p99_ms``) invert that
+verdict: the rise is the regression.
 
 Surfaces:
   - ``ray-tpu perf check``     measure now, compare vs the ledger head
@@ -61,7 +63,17 @@ _METRIC_BANDS: Dict[str, Dict[int, float]] = {
     "single_client_put_gigabytes": {1: 0.45, 3: 0.30},
     # wait() at 1k refs batches timers across the whole submit window
     "wait_1k_refs": {1: 0.45, 3: 0.30},
+    # serve/llm engine load test: throughput jitters with allocator/GC
+    # state across a multi-second numpy run; the p99 row additionally
+    # rides the tail of 1k stream completions
+    "serve_llm_tokens_per_s": {1: 0.45, 3: 0.30},
+    "serve_llm_static_batch_tokens_per_s": {1: 0.45, 3: 0.30},
+    "serve_llm_stream_p99_ms": {1: 0.45, 3: 0.30},
 }
+
+# Metrics where LOWER is better (latencies): the gate inverts the verdict —
+# a rise beyond the band is the regression, a drop the improvement.
+_LOWER_IS_BETTER = {"serve_llm_stream_p99_ms"}
 
 
 def noise_band(metric: str, reps: int = 1) -> float:
@@ -123,10 +135,15 @@ def compare(baseline: Dict[str, float], current: Dict[str, float],
         else:
             ratio = new / old
             row["ratio"] = round(ratio, 4)
-            if ratio < 1.0 - band:
+            # latency-style metrics invert: a RISE is the regression
+            worse = (ratio > 1.0 + band if name in _LOWER_IS_BETTER
+                     else ratio < 1.0 - band)
+            better = (ratio < 1.0 - band if name in _LOWER_IS_BETTER
+                      else ratio > 1.0 + band)
+            if worse:
                 row["status"] = "regression"
                 out["regressions"].append(name)
-            elif ratio > 1.0 + band:
+            elif better:
                 row["status"] = "improved"
                 out["improvements"].append(name)
             else:

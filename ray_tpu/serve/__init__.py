@@ -24,9 +24,11 @@ from ray_tpu.serve._deployment import (
 from ray_tpu.serve._handle import CONTROLLER_NAME, DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve import llm  # noqa: F401 — serve.llm.* public surface
 
 __all__ = [
     "deployment",
+    "llm",
     "run",
     "start",
     "shutdown",

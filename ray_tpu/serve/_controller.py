@@ -372,7 +372,11 @@ class ServeController:
             for rname in st["replicas"]:
                 pushed = self._metrics.get(rname)
                 if pushed is not None and now - pushed[0] < 3.0:
-                    ongoing += pushed[1].get("ongoing", 0)
+                    # "load" folds in engine-internal queues (serve.llm
+                    # sequences waiting+running) on top of the request-level
+                    # in-flight count; older replicas only push "ongoing"
+                    meta = pushed[1]
+                    ongoing += meta.get("load", meta.get("ongoing", 0))
             import math
 
             target_per = max(cfg.get("target_ongoing_requests", 2.0), 0.1)

@@ -300,6 +300,20 @@ class DeploymentHandle:
     def close(self):
         self._closed = True
 
+    def pick_replica(self) -> tuple:
+        """Pick one replica (pow-2 probed, like remote()) and charge an
+        in-flight slot to it; returns ``(replica_name, actor)``. The
+        caller OWNS the slot and must call :meth:`release` when the
+        pinned interaction ends — the proxy's llm stream path uses this
+        to keep every pull of one token stream on the replica that holds
+        its KV blocks."""
+        self._refresh_replicas()
+        return self._pick()
+
+    def release(self, replica_name: str):
+        """Return the in-flight slot taken by :meth:`pick_replica`."""
+        self._done(replica_name)
+
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         t0 = time.time()
         deadline = t0 + 60

@@ -67,6 +67,12 @@ class ProxyActor:
             srv.register("ServeCall", self._handle_rpc_call)
             srv.register("ServeStreamNext", self._handle_rpc_stream_next)
             srv.register("ServeStreamCancel", self._handle_rpc_stream_cancel)
+            # llm token streaming (serve/llm): prompts arrive as raw OOB
+            # frames, token deltas leave as raw OOB frames — the proxy
+            # forwards the replica's int32 buffer without re-serializing
+            srv.register("ServeLlmOpen", self._handle_llm_open)
+            srv.register("ServeLlmNext", self._handle_llm_next)
+            srv.register("ServeLlmCancel", self._handle_llm_cancel)
             self._rpc_port = await srv.start(port)
             self._rpc_server = srv
             logger.info("serve rpc ingress on %d", self._rpc_port)
@@ -221,6 +227,167 @@ class ProxyActor:
         if rec is not None:
             self._close_stream_record(rec)
         return {"ok": True}
+
+    # ------------------------------------------------------- llm OOB streams
+    # The continuous-batching engine's zero-copy egress (serve/llm): one
+    # stream = one sequence pinned to the replica holding its KV blocks.
+    # Open/Next/Cancel mirror the generic stream verbs, but the payloads are
+    # raw int32 token buffers carried in out-of-band frames: the prompt's
+    # "_oob" bytes go to the replica untouched, and ServeLlmNext wraps the
+    # replica's token bytes in an OobPayload — straight to the client
+    # socket, never through cloudpickle in this process.
+
+    async def _handle_llm_open(self, req):
+        self._sweep_llm_streams()
+        app = req.get("app")
+        await self._route("/")
+        info = self._routes.get(app)
+        if info is None:
+            # cache miss may just be a fresh deploy inside the TTL window:
+            # force one refresh before declaring the app unknown
+            self._routes_at = 0.0
+            await self._route("/")
+            info = self._routes.get(app)
+        if info is None:
+            return {"error": f"no such application {app!r}",
+                    "app_error": False}
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        ingress = info["ingress"]
+        if not hasattr(self, "_llm_handles"):
+            self._llm_handles = {}
+            self._llm_streams = {}
+        handle = self._llm_handles.get(ingress)
+        if handle is None:
+            handle = self._llm_handles[ingress] = DeploymentHandle(ingress)
+        prompt = req.get("_oob")
+        if prompt is not None:
+            prompt = bytes(prompt)  # raw int32 token ids from the frame
+        else:
+            prompt = req.get("prompt")
+        sampling = req.get("sampling") or {}
+        timeout = min(float(req.get("timeout") or 60.0), 300.0)
+        loop = asyncio.get_running_loop()
+
+        def _open():
+            import ray_tpu
+
+            name, replica = handle.pick_replica()
+            try:
+                out = ray_tpu.get(
+                    replica.llm_call.remote(
+                        "llm_submit", (prompt,), {"sampling": sampling}),
+                    timeout=timeout,
+                )
+                return name, replica, out["request_id"]
+            except BaseException:
+                handle.release(name)
+                raise
+
+        try:
+            name, replica, rid = await asyncio.wait_for(
+                loop.run_in_executor(self._stream_pool, _open), timeout + 10)
+        except Exception as e:  # noqa: BLE001
+            return self._llm_error(e)
+        import time as _time
+        import uuid as _uuid
+
+        sid = _uuid.uuid4().hex
+        self._llm_streams[sid] = {
+            "replica": replica, "name": name, "rid": rid,
+            "ingress": ingress, "ts": _time.time(),
+        }
+        return {"stream_id": sid}
+
+    @staticmethod
+    def _llm_error(e) -> dict:
+        """Typed error reply; admission rejections stay structured so the
+        client can distinguish backpressure (retry with backoff / route
+        elsewhere) from a real failure."""
+        from ray_tpu.exceptions import TaskError
+
+        cause = e.cause if isinstance(e, TaskError) else e
+        out = {"error": str(cause), "app_error": True}
+        to_dict = getattr(cause, "to_dict", None)
+        if callable(to_dict) and getattr(cause, "queue_depth", None) is not None:
+            out.update(to_dict())
+        return out
+
+    async def _handle_llm_next(self, req):
+        rec = getattr(self, "_llm_streams", {}).get(req.get("stream_id"))
+        if rec is None:
+            return {"error": "unknown llm stream %r" % req.get("stream_id"),
+                    "app_error": False}
+        import time as _time
+
+        rec["ts"] = _time.time()
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        max_tokens = max(0, int(req.get("max_tokens") or 0))
+        wait_s = min(float(req.get("wait_s")
+                           or RTPU_CONFIG.llm_pull_wait_s), 30.0)
+        loop = asyncio.get_running_loop()
+
+        def _pull():
+            import ray_tpu
+
+            return ray_tpu.get(
+                rec["replica"].llm_call.remote(
+                    "llm_pull", (rec["rid"],),
+                    {"max_tokens": max_tokens, "wait_s": wait_s}),
+                timeout=wait_s + 30,
+            )
+
+        try:
+            out = await asyncio.wait_for(
+                loop.run_in_executor(self._stream_pool, _pull), wait_s + 40)
+        except Exception as e:  # noqa: BLE001
+            self._drop_llm_stream(req.get("stream_id"), cancel=True)
+            return self._llm_error(e)
+        if out["done"]:
+            self._drop_llm_stream(req.get("stream_id"), cancel=False)
+        from ray_tpu._private.rpc import OobPayload
+
+        data = out["tokens"] or b""
+        return OobPayload(
+            {"done": out["done"], "finish_reason": out.get("finish_reason"),
+             "n": len(data) // 4},
+            data,
+        )
+
+    async def _handle_llm_cancel(self, req):
+        self._drop_llm_stream(req.get("stream_id"), cancel=True)
+        return {"ok": True}
+
+    def _drop_llm_stream(self, sid, cancel: bool):
+        rec = getattr(self, "_llm_streams", {}).pop(sid, None)
+        if rec is None:
+            return
+        handle = getattr(self, "_llm_handles", {}).get(rec["ingress"])
+        if handle is not None:
+            handle.release(rec["name"])
+        if cancel:
+            def _cancel():
+                try:
+                    rec["replica"].llm_call.remote(
+                        "llm_cancel", (rec["rid"],), {})
+                except Exception:
+                    pass
+
+            try:
+                self._stream_pool.submit(_cancel)
+            except Exception:
+                pass
+
+    def _sweep_llm_streams(self, idle_s: float = 600.0):
+        """Free streams an absent client stopped pulling: their sequences
+        are cancelled on the replica so the KV blocks return to the pool."""
+        import time as _time
+
+        now = _time.time()
+        for sid, rec in list(getattr(self, "_llm_streams", {}).items()):
+            if now - rec["ts"] > idle_s:
+                self._drop_llm_stream(sid, cancel=True)
 
     async def _route(self, path: str):
         """Longest route_prefix match. The route table refreshes on a short

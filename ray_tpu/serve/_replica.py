@@ -87,9 +87,44 @@ class Replica:
         # answer probes instantly (reference: pow_2_scheduler probes).
         self._max_ongoing = serialized.get("max_ongoing", 8)
         self._sem = None  # lazy: created on the actor loop
+        # Identity hook: engine-style callables (serve.llm.LLMReplica) tag
+        # their own telemetry series with the deployment/replica labels.
+        self._push_identity()
 
     def _metric_tags(self) -> dict:
         return {"deployment": self._name, "replica": self._replica_tag}
+
+    def _push_identity(self):
+        hook = getattr(self._callable, "__serve_identity__", None)
+        if callable(hook):
+            try:
+                hook(self._name, self._replica_tag)
+            except Exception:
+                pass
+
+    def _extra_load(self) -> int:
+        """Engine-style callables report internal load (e.g. the llm
+        engine's waiting+running sequences) beyond the request-level
+        _ongoing count — the autoscaler and queue gauge fold it in."""
+        hook = getattr(self._callable, "__serve_load__", None)
+        if callable(hook):
+            try:
+                return max(0, int(hook()))
+            except Exception:
+                return 0
+        return 0
+
+    async def llm_call(self, method: str, args: tuple, kwargs: dict):
+        """Direct dispatch for llm control-plane calls (submit / pull /
+        cancel / stats from the proxy's OOB stream path). Deliberately NOT
+        gated by the max_ongoing semaphore: the engine applies its own
+        admission control, and a pull must never queue behind the user
+        requests whose tokens it is draining."""
+        target = getattr(self._callable, method)
+        result = target(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            result = await result
+        return result
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict) -> Any:
         import asyncio
@@ -306,6 +341,7 @@ class Replica:
         # short tag: SERVE_REPLICA::<dep>::<id> -> <dep>#<id> keeps the
         # Prometheus label readable and the series cardinality = replicas
         self._replica_tag = replica_name.split("::")[-1]
+        self._push_identity()  # now with the real replica tag
 
         async def _loop():
             import ray_tpu
@@ -327,13 +363,14 @@ class Replica:
                         healthy = True
                     except Exception:
                         healthy = False
+                extra = self._extra_load()
                 try:
                     # queue/in-flight gauges ride the same 0.5s cadence as
                     # the controller push; exported via the worker's
                     # util.metrics flush → GCS → Prometheus
                     m = _serve_metrics()
                     tags = self._metric_tags()
-                    m["queue"].set(self._ongoing, tags=tags)
+                    m["queue"].set(self._ongoing + extra, tags=tags)
                     m["inflight"].set(self._running, tags=tags)
                 except Exception:
                     pass
@@ -345,6 +382,10 @@ class Replica:
                         replica_name,
                         {
                             "ongoing": self._ongoing,
+                            # autoscaling signal: request-level in-flight
+                            # plus the callable's own queue (llm engine
+                            # sequences waiting+running)
+                            "load": self._ongoing + extra,
                             "handled": self._handled,
                             "healthy": healthy,
                         },

@@ -4,6 +4,16 @@ Counterpart of the reference's batching (reference:
 python/ray/serve/batching.py — queue individual calls, run the wrapped
 method once per batch of up to max_batch_size after at most
 batch_wait_timeout_s, scatter results back).
+
+Queue lifetime: each (instance, method) pair owns one ``_BatchQueue``
+stored ON the instance, so it dies with the replica — a global
+``id(instance)``-keyed registry could cross-wire a new replica's calls
+into a dead one's queue when CPython reuses the id. Timer hygiene: a
+size-triggered flush cancels the pending timeout timer (armed for the
+batch just drained); letting it live would flush the NEXT partial batch
+early, before its own ``batch_wait_timeout_s``. Cancelled callers
+(client disconnects while queued) are dropped from the batch before the
+user function runs — no compute for results nobody will read.
 """
 
 from __future__ import annotations
@@ -35,9 +45,20 @@ class _BatchQueue:
         await self._flush(instance)
 
     async def _flush(self, instance):
-        if not self.queue:
+        # A size-triggered flush drains the queue the pending timer was
+        # armed for; the orphaned timer would otherwise fire later and
+        # flush a NEWER partial batch before its batch_wait_timeout_s.
+        flusher = self._flusher
+        if (flusher is not None and not flusher.done()
+                and flusher is not asyncio.current_task()):
+            flusher.cancel()
+        self._flusher = None
+        # Drop entries whose waiter is already done — a cancelled caller
+        # (client disconnect) must not cost a slot in the user batch.
+        batch = [(i, f) for i, f in self.queue if not f.done()]
+        self.queue = []
+        if not batch:
             return
-        batch, self.queue = self.queue, []
         items = [b[0] for b in batch]
         try:
             if instance is not None:
@@ -68,7 +89,11 @@ def batch(
     are queued and executed as batches."""
 
     def wrap(fn):
-        queues = {}  # instance id -> _BatchQueue (per-replica state)
+        # per-(instance, method) queue lives on the instance itself (see
+        # module docstring); function deployments get one closure queue
+        attr = f"__serve_batch_queue_{fn.__name__}"
+        holder: List[_BatchQueue] = []  # instance=None case
+        fallback = {}  # instances rejecting setattr (__slots__): legacy map
 
         @functools.wraps(fn)
         async def wrapper(*args):
@@ -78,10 +103,19 @@ def batch(
                 instance, item = None, args[0]
             else:
                 raise TypeError("@serve.batch methods take exactly one argument")
-            key = id(instance)
-            q = queues.get(key)
-            if q is None:
-                q = queues[key] = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+            if instance is None:
+                if not holder:
+                    holder.append(
+                        _BatchQueue(fn, max_batch_size, batch_wait_timeout_s))
+                q = holder[0]
+            else:
+                q = getattr(instance, attr, None)
+                if q is None:
+                    q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                    try:
+                        setattr(instance, attr, q)
+                    except AttributeError:
+                        q = fallback.setdefault(id(instance), q)
             return await q.submit(instance, item)
 
         wrapper._is_serve_batch = True

@@ -1,0 +1,403 @@
+"""Model adapters: the thin ``generate_step`` seam between the engine and
+``ray_tpu/models``.
+
+The zoo models (gpt2 / llama / gpt2_moe) are training-first flax modules
+with no KV cache plumbing, so the adapters re-express their forward pass as
+explicit numpy math over the raw param pytrees in two shapes the engine
+needs:
+
+  ``prefill(tokens)``  one sequence's full context: returns the last
+                       position's logits plus per-layer K/V for every
+                       position (the copy-on-admit cache write);
+  ``decode(...)``      ONE fused step for the whole running batch: each
+                       sequence contributes one new token + its gathered
+                       paged KV; returns next-token logits and the new
+                       token's K/V to append.
+
+Everything is fp32 numpy — bit-for-bit deterministic, chip-free (tier-1
+and the CPU-plane bench run the real engine), and byte-equivalent to the
+flax forward for fp32 configs (tests/test_serve_llm.py pins gpt2 and llama
+against ``models.*.forward``). On a TPU replica ``decode`` is the seam
+where a pallas paged-attention kernel slots in; the engine never sees the
+difference.
+
+MoE note: serving uses dropless top-k routing (every token reaches all its
+k experts). Train-time static capacity can drop tokens under load — a
+nondeterministic-under-batching behavior a server must not have.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ModelAdapter", "GPT2Adapter", "LlamaAdapter", "GPT2MoEAdapter",
+           "FakeAdapter", "build_adapter", "MODEL_ZOO"]
+
+
+def _np_tree(params) -> Dict[str, Any]:
+    """Convert a (possibly jax) param pytree to fp32 numpy once, at adapter
+    construction — the engine's hot path never touches jax after this."""
+    if isinstance(params, dict):
+        return {k: _np_tree(v) for k, v in params.items()}
+    return np.asarray(params, dtype=np.float32)
+
+
+def _layernorm(x: np.ndarray, p: Dict[str, np.ndarray],
+               eps: float = 1e-6) -> np.ndarray:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def _rmsnorm(x: np.ndarray, weight: np.ndarray, eps: float) -> np.ndarray:
+    var = (x * x).mean(axis=-1, keepdims=True)
+    return x / np.sqrt(var + eps) * weight
+
+
+def _gelu(x: np.ndarray) -> np.ndarray:
+    # tanh approximation — matches nn.gelu(approximate=True)
+    return 0.5 * x * (1.0 + np.tanh(
+        math.sqrt(2.0 / math.pi) * (x + 0.044715 * x ** 3)))
+
+
+def _softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    x = x - x.max(axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _attend(q, k_ctx, v_ctx, lens, k_new, v_new):
+    """Fused single-query attention over (paged-gathered context + self).
+
+    q/k_new/v_new ``[B, H, D]``; k_ctx/v_ctx ``[B, Tmax, H, D]`` zero-padded
+    past ``lens [B]``. Returns ``[B, H, D]``.
+    """
+    B, Tmax, H, D = k_ctx.shape
+    scale = 1.0 / math.sqrt(D)
+    s_ctx = np.einsum("bhd,bthd->bht", q, k_ctx) * scale
+    mask = np.arange(Tmax)[None, :] >= lens[:, None]          # [B, Tmax]
+    s_ctx = np.where(mask[:, None, :], -1e30, s_ctx)
+    s_self = np.einsum("bhd,bhd->bh", q, k_new)[..., None] * scale
+    probs = _softmax(np.concatenate([s_ctx, s_self], axis=-1))  # [B,H,T+1]
+    out = np.einsum("bht,bthd->bhd", probs[..., :Tmax], v_ctx)
+    return out + probs[..., Tmax:] * v_new
+
+
+def _causal_attend(q, k, v):
+    """Prefill self-attention, one sequence: q/k/v ``[T, H, D]``."""
+    T, H, D = q.shape
+    s = np.einsum("thd,shd->hts", q, k) / math.sqrt(D)
+    s = np.where(np.tril(np.ones((T, T), dtype=bool))[None], s, -1e30)
+    return np.einsum("hts,shd->thd", _softmax(s), v)
+
+
+def _repeat_kv(x: np.ndarray, rep: int) -> np.ndarray:
+    """GQA broadcast: [..., Hkv, D] -> [..., Hkv*rep, D]."""
+    if rep == 1:
+        return x
+    return np.repeat(x, rep, axis=-2)
+
+
+class ModelAdapter:
+    """Shape contract the engine sizes its cache from."""
+
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    max_context: int
+
+    def prefill(self, tokens: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def decode(self, tokens: np.ndarray, positions: np.ndarray,
+               k_ctx: np.ndarray, v_ctx: np.ndarray, lens: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------- GPT-2
+
+
+class GPT2Adapter(ModelAdapter):
+    """Numpy twin of ``models/gpt2.py`` (weight-tied head, learned
+    positions, tanh-gelu MLP)."""
+
+    def __init__(self, config, params):
+        self.cfg = config
+        self.p = _np_tree(params)
+        self.n_layers = config.n_layer
+        self.n_heads = self.n_kv_heads = config.n_head
+        self.head_dim = config.n_embd // config.n_head
+        self.vocab_size = config.vocab_size
+        self.max_context = config.block_size
+
+    # hook GPT2MoEAdapter overrides for its MoE blocks
+    def _ffn(self, x: np.ndarray, lp: Dict[str, Any]) -> np.ndarray:
+        h = _gelu(x @ lp["mlp"]["c_fc"]["kernel"] + lp["mlp"]["c_fc"]["bias"])
+        return h @ lp["mlp"]["c_proj"]["kernel"] + lp["mlp"]["c_proj"]["bias"]
+
+    def _qkv(self, h: np.ndarray, lp) -> Tuple[np.ndarray, ...]:
+        qkv = h @ lp["attn"]["c_attn"]["kernel"] + lp["attn"]["c_attn"]["bias"]
+        q, k, v = np.split(qkv, 3, axis=-1)
+        shape = h.shape[:-1] + (self.n_heads, self.head_dim)
+        return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+
+    def _logits(self, x: np.ndarray) -> np.ndarray:
+        return _layernorm(x, self.p["ln_f"]) @ self.p["wte"]["embedding"].T
+
+    def prefill(self, tokens: np.ndarray):
+        T = len(tokens)
+        p = self.p
+        x = p["wte"]["embedding"][tokens] + p["wpe"]["embedding"][:T]
+        ks, vs = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            q, k, v = self._qkv(_layernorm(x, lp["ln_1"]), lp)
+            ks.append(k)
+            vs.append(v)
+            y = _causal_attend(q, k, v).reshape(T, -1)
+            x = x + y @ lp["attn"]["c_proj"]["kernel"] \
+                + lp["attn"]["c_proj"]["bias"]
+            x = x + self._ffn(_layernorm(x, lp["ln_2"]), lp)
+        return self._logits(x[-1]), np.stack(ks), np.stack(vs)
+
+    def decode(self, tokens, positions, k_ctx, v_ctx, lens):
+        p = self.p
+        x = p["wte"]["embedding"][tokens] + p["wpe"]["embedding"][positions]
+        k_news, v_news = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            q, k, v = self._qkv(_layernorm(x, lp["ln_1"]), lp)
+            k_news.append(k)
+            v_news.append(v)
+            y = _attend(q, k_ctx[:, li], v_ctx[:, li], lens, k, v)
+            x = x + y.reshape(len(tokens), -1) \
+                @ lp["attn"]["c_proj"]["kernel"] + lp["attn"]["c_proj"]["bias"]
+            x = x + self._ffn(_layernorm(x, lp["ln_2"]), lp)
+        return (self._logits(x),
+                np.stack(k_news, axis=1), np.stack(v_news, axis=1))
+
+
+# ---------------------------------------------------------------------- MoE
+
+
+class GPT2MoEAdapter(GPT2Adapter):
+    """gpt2_moe: every ``moe_every``-th block routes its FFN through
+    dropless top-k experts (see module docstring for the capacity note)."""
+
+    def _ffn(self, x: np.ndarray, lp: Dict[str, Any]) -> np.ndarray:
+        if "moe" not in lp:
+            return super()._ffn(x, lp)
+        mp = lp["moe"]
+        cfg = self.cfg.moe
+        probs = _softmax(x @ mp["router"]["kernel"] + mp["router"]["bias"])
+        k = cfg.top_k
+        idx = np.argsort(probs, axis=-1)[..., ::-1][..., :k]      # [T, k]
+        gates = np.take_along_axis(probs, idx, axis=-1)
+        gates = gates / np.maximum(gates.sum(axis=-1, keepdims=True), 1e-9)
+        out = np.zeros_like(x)
+        for j in range(k):
+            for e in np.unique(idx[..., j]):
+                rows = idx[..., j] == e
+                h = _gelu(x[rows] @ mp["wi"][e]) @ mp["wo"][e]
+                out[rows] += gates[rows, j:j + 1] * h
+        return out
+
+
+# --------------------------------------------------------------------- llama
+
+
+class LlamaAdapter(ModelAdapter):
+    """Numpy twin of ``models/llama.py``: RMSNorm, rotate-half RoPE (keys
+    cached post-rotation, the standard trick), GQA, SwiGLU."""
+
+    def __init__(self, config, params):
+        self.cfg = config
+        self.p = _np_tree(params)
+        self.n_layers = config.n_layer
+        self.n_heads = config.n_head
+        self.n_kv_heads = config.n_kv_head
+        self.head_dim = config.head_dim
+        self.vocab_size = config.vocab_size
+        self.max_context = config.block_size
+
+    def _rope(self, x: np.ndarray, positions: np.ndarray) -> np.ndarray:
+        """x [..., T?, H, D] with matching leading position axis."""
+        D = self.head_dim
+        inv = 1.0 / (self.cfg.rope_theta
+                     ** (np.arange(0, D, 2, dtype=np.float32) / D))
+        ang = positions.astype(np.float32)[..., None] * inv     # [T?, D/2]
+        cos = np.cos(ang)[..., None, :]
+        sin = np.sin(ang)[..., None, :]
+        x1, x2 = np.split(x, 2, axis=-1)
+        return np.concatenate([x1 * cos - x2 * sin,
+                               x1 * sin + x2 * cos], axis=-1)
+
+    def _proj(self, h, lp, name, heads):
+        return (h @ lp["attn"][name]["kernel"]).reshape(
+            h.shape[:-1] + (heads, self.head_dim))
+
+    def _block_mlp(self, x, lp):
+        g = x @ lp["mlp"]["gate"]["kernel"]
+        return ((g / (1.0 + np.exp(-g))) * (x @ lp["mlp"]["up"]["kernel"])) \
+            @ lp["mlp"]["down"]["kernel"]
+
+    def _logits(self, x):
+        return _rmsnorm(x, self.p["final_norm"]["weight"],
+                        self.cfg.rms_eps) @ self.p["lm_head"]["kernel"]
+
+    def prefill(self, tokens: np.ndarray):
+        cfg, p = self.cfg, self.p
+        T = len(tokens)
+        pos = np.arange(T)
+        rep = cfg.n_head // cfg.n_kv_head
+        x = p["tok_emb"]["embedding"][tokens]
+        ks, vs = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["weight"], cfg.rms_eps)
+            q = self._rope(self._proj(h, lp, "wq", cfg.n_head), pos)
+            k = self._rope(self._proj(h, lp, "wk", cfg.n_kv_head), pos)
+            v = self._proj(h, lp, "wv", cfg.n_kv_head)
+            ks.append(k)
+            vs.append(v)
+            y = _causal_attend(q, _repeat_kv(k, rep), _repeat_kv(v, rep))
+            x = x + y.reshape(T, -1) @ lp["attn"]["wo"]["kernel"]
+            x = x + self._block_mlp(
+                _rmsnorm(x, lp["mlp_norm"]["weight"], cfg.rms_eps), lp)
+        return self._logits(x[-1]), np.stack(ks), np.stack(vs)
+
+    def decode(self, tokens, positions, k_ctx, v_ctx, lens):
+        cfg, p = self.cfg, self.p
+        rep = cfg.n_head // cfg.n_kv_head
+        x = p["tok_emb"]["embedding"][tokens]
+        k_news, v_news = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["weight"], cfg.rms_eps)
+            q = self._rope(self._proj(h, lp, "wq", cfg.n_head), positions)
+            k = self._rope(self._proj(h, lp, "wk", cfg.n_kv_head), positions)
+            v = self._proj(h, lp, "wv", cfg.n_kv_head)
+            k_news.append(k)
+            v_news.append(v)
+            y = _attend(q,
+                        _repeat_kv(k_ctx[:, li], rep),
+                        _repeat_kv(v_ctx[:, li], rep),
+                        lens, _repeat_kv(k, rep), _repeat_kv(v, rep))
+            x = x + y.reshape(len(tokens), -1) @ lp["attn"]["wo"]["kernel"]
+            x = x + self._block_mlp(
+                _rmsnorm(x, lp["mlp_norm"]["weight"], cfg.rms_eps), lp)
+        return (self._logits(x),
+                np.stack(k_news, axis=1), np.stack(v_news, axis=1))
+
+
+# ---------------------------------------------------------------------- fake
+
+
+class FakeAdapter(ModelAdapter):
+    """Model-free adapter for scheduler/engine tests and pure-batching
+    benches. Deterministic: the next token is a function of the last token
+    AND the KV cache contents (each position's K stores its token id), so a
+    block-table bug or a bad gather changes the output stream."""
+
+    def __init__(self, vocab_size: int = 97, n_layers: int = 1,
+                 n_kv_heads: int = 1, head_dim: int = 1,
+                 max_context: int = 4096, step_cost_s: float = 0.0):
+        self.vocab_size = vocab_size
+        self.n_layers = n_layers
+        self.n_heads = self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.max_context = max_context
+        self.step_cost_s = step_cost_s  # simulated model time per step
+
+    def _next(self, ctx_sum: np.ndarray, tokens: np.ndarray) -> np.ndarray:
+        return (ctx_sum.astype(np.int64) + tokens * 31 + 7) % self.vocab_size
+
+    def _logits_for(self, nxt: np.ndarray) -> np.ndarray:
+        out = np.zeros(nxt.shape + (self.vocab_size,), dtype=np.float32)
+        np.put_along_axis(out, nxt[..., None], 1.0, axis=-1)
+        return out
+
+    def _kv(self, tokens: np.ndarray):
+        kv = np.broadcast_to(
+            tokens.astype(np.float32)[..., None, None, None],
+            tokens.shape + (self.n_layers, self.n_kv_heads, self.head_dim),
+        ).copy()
+        return kv, kv.copy()
+
+    def prefill(self, tokens: np.ndarray):
+        if self.step_cost_s:
+            import time
+            time.sleep(self.step_cost_s)
+        tokens = np.asarray(tokens)
+        # same semantics as decode with cache = tokens[:-1], input = last —
+        # a preempted sequence's recompute must continue identically
+        nxt = self._next(np.float64(tokens[:-1].sum()), tokens[-1:])
+        k, v = self._kv(tokens)  # [T, L, H, D] -> [L, T, H, D]
+        return (self._logits_for(nxt)[0],
+                np.moveaxis(k, 0, 1), np.moveaxis(v, 0, 1))
+
+    def decode(self, tokens, positions, k_ctx, v_ctx, lens):
+        if self.step_cost_s:
+            import time
+            time.sleep(self.step_cost_s)
+        # context read back THROUGH the gathered cache: [B, L, Tmax, H, D]
+        # (masked by lens — padding slots may carry stale block data)
+        valid = np.arange(k_ctx.shape[2])[None, :] < lens[:, None]
+        ctx_sum = (k_ctx[:, 0, :, 0, 0] * valid).sum(axis=1)
+        nxt = self._next(ctx_sum, np.asarray(tokens))
+        k, v = self._kv(np.asarray(tokens))  # [B, L, H, D]
+        return self._logits_for(nxt), k, v
+
+
+# ----------------------------------------------------------------- model zoo
+
+
+MODEL_ZOO = {
+    "gpt2-tiny": ("gpt2", "tiny"),
+    "gpt2": ("gpt2", "gpt2_124m"),
+    "gpt2-moe-tiny": ("gpt2_moe", "tiny_moe"),
+    "llama-tiny": ("llama", "tiny"),
+    "llama-160m": ("llama", "llama_160m"),
+    "fake": ("fake", None),
+}
+
+
+def build_adapter(model: str, model_config: Optional[dict] = None,
+                  seed: int = 0) -> ModelAdapter:
+    """Resolve a zoo name to (config, fresh params, adapter). Checkpoint
+    loading is out of scope for this engine PR — params are seeded random,
+    which is exactly what the bench and tests need. jax/flax imports stay
+    inside this function so ``import ray_tpu.serve.llm`` is cheap."""
+    if model == "fake":
+        return FakeAdapter(**(model_config or {}))
+    if model not in MODEL_ZOO:
+        raise ValueError(
+            f"unknown model {model!r}; zoo: {sorted(MODEL_ZOO)}")
+    family, preset = MODEL_ZOO[model]
+    kw = dict(model_config or {})
+    import jax
+    import jax.numpy as jnp
+
+    kw.setdefault("dtype", jnp.float32)  # fp32: the adapters' native math
+    rng = jax.random.PRNGKey(seed)
+    if family == "gpt2":
+        from ray_tpu.models import gpt2 as m
+
+        cfg = getattr(m.GPT2Config, preset)(**kw)
+        return GPT2Adapter(cfg, m.init_params(cfg, rng))
+    if family == "gpt2_moe":
+        from ray_tpu.models import gpt2_moe as m
+
+        cfg = getattr(m.GPT2MoEConfig, preset)(**kw)
+        return GPT2MoEAdapter(cfg, m.init_params(cfg, rng))
+    from ray_tpu.models import llama as m
+
+    cfg = getattr(m.LlamaConfig, preset)(**kw)
+    return LlamaAdapter(cfg, m.init_params(cfg, rng))

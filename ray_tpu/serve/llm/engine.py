@@ -1,0 +1,547 @@
+"""LLM engine + the ``LLMReplica`` deployment class.
+
+``LLMEngine`` is the synchronous core: it owns the paged cache, the
+continuous-batching scheduler and a model adapter, and advances the world
+one :meth:`step` at a time (prefill the newly admitted, one fused decode
+for everything running, commit + deliver tokens). It is thread-safe behind
+one coarse lock and has no asyncio/ray dependencies — the bench and the
+unit tests drive it directly.
+
+``LLMReplica`` is the serve-facing wrapper: an async step loop pumps the
+engine off the actor's event loop (model math runs in the default
+executor so queue probes and pulls stay responsive), requests arrive as
+``llm_submit``/``llm_pull``/``llm_cancel`` (the proxy's zero-copy OOB
+path), ``generate``/``stream`` (plain handle + HTTP streaming paths), and
+admission control sheds load with the structured :class:`LLMBackpressure`
+error before the cache can OOM.
+
+Per-step telemetry rides the PR 1 metrics path (names are a stability
+contract, see ``util/metrics.py``):
+
+  ray_tpu_llm_tokens_per_s        gauge, EMA of generated tokens/s
+  ray_tpu_llm_kv_utilization      gauge, 0-1 fraction of KV blocks in use
+  ray_tpu_llm_batch_size          gauge, sequences in the last step
+  ray_tpu_llm_preemptions_total   counter
+
+and the flight recorder gets ``llm.admit`` / ``llm.preempt`` /
+``llm.finish`` events (PR 3 contract: cheap tuples, no formatting until
+dump).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu._private.config import RTPU_CONFIG
+from ray_tpu.serve.llm import scheduler as sched_mod
+from ray_tpu.serve.llm.adapters import ModelAdapter, build_adapter
+from ray_tpu.serve.llm.kv_cache import PagedKVCache
+from ray_tpu.serve.llm.scheduler import Scheduler, Sequence
+
+
+class LLMBackpressure(RuntimeError):
+    """Structured admission rejection: the engine sheds load instead of
+    OOMing the KV cache. Carries enough for a client (or the proxy) to
+    make a real decision — queue elsewhere, back off, or surface a 429."""
+
+    def __init__(self, queue_depth: int, max_waiting: int,
+                 kv_utilization: float):
+        self.queue_depth = int(queue_depth)
+        self.max_waiting = int(max_waiting)
+        self.kv_utilization = float(kv_utilization)
+        super().__init__(
+            f"llm admission rejected: queue_depth={queue_depth} >= "
+            f"max_waiting={max_waiting} (kv_utilization="
+            f"{kv_utilization:.2f}); back off and retry"
+        )
+
+    def __reduce__(self):
+        # pickles across the actor boundary with its structure intact
+        # (default Exception.__reduce__ would replay the message string
+        # into the 3-arg __init__ and blow up at unpickle time)
+        return (LLMBackpressure,
+                (self.queue_depth, self.max_waiting, self.kv_utilization))
+
+    def to_dict(self) -> dict:
+        return {"backpressure": True, "queue_depth": self.queue_depth,
+                "max_waiting": self.max_waiting,
+                "kv_utilization": round(self.kv_utilization, 4)}
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 16
+    temperature: float = 0.0   # 0 = greedy
+    top_k: int = 0             # 0 = full vocab
+    eos_id: Optional[int] = None
+    seed: Optional[int] = None
+
+
+class _SeqSampling:
+    """Per-sequence sampling state riding on Sequence.sampling."""
+
+    __slots__ = ("params", "rng")
+
+    def __init__(self, params: SamplingParams):
+        self.params = params
+        self.rng = (np.random.default_rng(params.seed)
+                    if params.temperature > 0 else None)
+
+
+_llm_metrics = None
+
+
+def _metrics():
+    global _llm_metrics
+    if _llm_metrics is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        tags = ("deployment", "replica")
+        _llm_metrics = {
+            "tokens_per_s": Gauge(
+                "ray_tpu_llm_tokens_per_s",
+                "generated tokens/s per llm replica (EMA)", tag_keys=tags),
+            "kv_util": Gauge(
+                "ray_tpu_llm_kv_utilization",
+                "fraction of paged KV blocks in use", tag_keys=tags),
+            "batch": Gauge(
+                "ray_tpu_llm_batch_size",
+                "sequences in the last engine step", tag_keys=tags),
+            "preempt": Counter(
+                "ray_tpu_llm_preemptions_total",
+                "sequences requeued on KV exhaustion", tag_keys=tags),
+        }
+    return _llm_metrics
+
+
+class _OutBuffer:
+    """Tokens produced but not yet pulled by the client."""
+
+    __slots__ = ("tokens", "done", "finish_reason")
+
+    def __init__(self):
+        self.tokens: List[int] = []
+        self.done = False
+        self.finish_reason: Optional[str] = None
+
+
+class LLMEngine:
+    """Synchronous continuous-batching engine (see module docstring)."""
+
+    def __init__(
+        self,
+        adapter: ModelAdapter,
+        *,
+        num_blocks: Optional[int] = None,
+        block_size: Optional[int] = None,
+        max_batch: Optional[int] = None,
+        max_waiting: Optional[int] = None,
+        name: str = "llm",
+    ):
+        self.adapter = adapter
+        block_size = int(block_size or RTPU_CONFIG.llm_block_size)
+        num_blocks = int(num_blocks or RTPU_CONFIG.llm_num_blocks)
+        self.cache = PagedKVCache(
+            num_blocks=num_blocks,
+            block_size=block_size,
+            n_layers=adapter.n_layers,
+            n_kv_heads=adapter.n_kv_heads,
+            head_dim=adapter.head_dim,
+        )
+        self.scheduler = Scheduler(
+            self.cache,
+            max_batch_size=int(max_batch or RTPU_CONFIG.llm_max_batch),
+            max_waiting=int(max_waiting or RTPU_CONFIG.llm_max_waiting),
+        )
+        self._out: Dict[str, _OutBuffer] = {}
+        self._lock = threading.RLock()
+        self._tags = {"deployment": name, "replica": ""}
+        self._tokens_per_s = 0.0  # EMA over steps
+        self.steps_total = 0
+        self.tokens_total = 0
+
+    def set_identity(self, deployment: str, replica: str = ""):
+        self._tags = {"deployment": deployment, "replica": replica}
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, prompt: List[int],
+               sampling: Optional[SamplingParams] = None) -> str:
+        """Admit a prompt; returns the request id. Raises
+        :class:`LLMBackpressure` past ``max_waiting`` queued prompts and
+        ``ValueError`` for prompts that can never fit the cache."""
+        sampling = sampling or SamplingParams()
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= self.adapter.vocab_size for t in prompt):
+            raise ValueError(
+                f"prompt token out of range [0, {self.adapter.vocab_size})")
+        limit = min(self.adapter.max_context,
+                    self.cache.num_blocks * self.cache.block_size)
+        if len(prompt) + 1 > limit:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens can never fit "
+                f"(context limit {limit})")
+        with self._lock:
+            if not self.scheduler.can_admit():
+                raise LLMBackpressure(
+                    self.scheduler.queue_depth(),
+                    self.scheduler.max_waiting,
+                    self.cache.utilization(),
+                )
+            seq = Sequence(prompt=prompt, max_tokens=sampling.max_tokens,
+                           eos_id=sampling.eos_id,
+                           sampling=_SeqSampling(sampling))
+            self.scheduler.add(seq)
+            self._out[seq.seq_id] = _OutBuffer()
+            return seq.seq_id
+
+    def cancel(self, seq_id: str) -> bool:
+        """Client abandoned the stream: stop generating and (for waiting
+        sequences now, running ones at the next schedule) free the KV."""
+        with self._lock:
+            ok = self.scheduler.cancel(seq_id)
+            buf = self._out.get(seq_id)
+            if buf is not None and not buf.done:
+                buf.done = True
+                buf.finish_reason = sched_mod.FINISH_CANCELLED
+            return ok
+
+    def pull(self, seq_id: str, max_tokens: int = 0):
+        """Drain up to ``max_tokens`` (0 = all) buffered tokens. Returns
+        ``(tokens, done, finish_reason)``; ``done`` only once the buffer is
+        empty AND the sequence finished. KeyError for unknown ids."""
+        with self._lock:
+            buf = self._out[seq_id]
+            n = len(buf.tokens) if max_tokens <= 0 else int(max_tokens)
+            out, buf.tokens = buf.tokens[:n], buf.tokens[n:]
+            done = buf.done and not buf.tokens
+            if done:
+                self._out.pop(seq_id, None)
+            return out, done, buf.finish_reason
+
+    # --------------------------------------------------------------- the step
+
+    def _sample(self, seq: Sequence, logits: np.ndarray) -> int:
+        sp: _SeqSampling = seq.sampling
+        p = sp.params
+        if p.temperature <= 0 or sp.rng is None:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / p.temperature
+        if p.top_k and p.top_k < len(z):
+            kth = np.partition(z, -p.top_k)[-p.top_k]
+            z = np.where(z < kth, -np.inf, z)
+        z -= z.max()
+        probs = np.exp(z)
+        probs /= probs.sum()
+        return int(sp.rng.choice(len(probs), p=probs))
+
+    def step(self) -> Dict[str, Any]:
+        """One engine iteration; returns step stats (also published as
+        gauges). A no-op returning ``{"batch_size": 0}`` when idle."""
+        from ray_tpu._private import flight_recorder as _fr
+
+        with self._lock:
+            t0 = time.perf_counter()
+            plan = self.scheduler.schedule()
+            for seq in plan.reaped:
+                self._finish_buffer(seq)
+            for seq in plan.preempted:
+                _fr.record("llm.preempt", b"",
+                           f"{seq.seq_id} ctx={seq.total_len}")
+            if plan.batch_size == 0:
+                self._publish(0, 0, 0.0)
+                return {"batch_size": 0, "tokens": 0}
+
+            sampled: Dict[str, int] = {}
+            for seq in plan.prefills:
+                ctx = np.asarray(seq.context_tokens(), dtype=np.int64)
+                logits, k, v = self.adapter.prefill(ctx)
+                self.cache.write_prefill(seq.seq_id, k, v)
+                sampled[seq.seq_id] = self._sample(seq, logits)
+                _fr.record("llm.admit", b"",
+                           f"{seq.seq_id} prompt={len(ctx)} "
+                           f"kv={self.cache.utilization():.2f}")
+            if plan.decodes:
+                ids = [s.seq_id for s in plan.decodes]
+                toks = np.asarray([s.tokens[-1] for s in plan.decodes],
+                                  dtype=np.int64)
+                pos = np.asarray([self.cache.seq_lens[i] for i in ids],
+                                 dtype=np.int64)
+                k_ctx, v_ctx, lens = self.cache.gather_batch(ids)
+                logits, k_new, v_new = self.adapter.decode(
+                    toks, pos, k_ctx, v_ctx, lens)
+                for i, seq in enumerate(plan.decodes):
+                    self.cache.append(seq.seq_id, k_new[i], v_new[i])
+                    sampled[seq.seq_id] = self._sample(seq, logits[i])
+
+            finished = self.scheduler.commit(sampled)
+            for seq_id, tok in sampled.items():
+                buf = self._out.get(seq_id)
+                if buf is not None and not buf.done:
+                    buf.tokens.append(tok)
+            for seq in finished:
+                self._finish_buffer(seq)
+                _fr.record("llm.finish", b"",
+                           f"{seq.seq_id} reason={seq.finish_reason} "
+                           f"tokens={len(seq.tokens)}")
+
+            dt = max(time.perf_counter() - t0, 1e-9)
+            n_tokens = len(sampled)
+            self.steps_total += 1
+            self.tokens_total += n_tokens
+            inst = n_tokens / dt
+            self._tokens_per_s = (inst if self._tokens_per_s == 0.0
+                                  else 0.8 * self._tokens_per_s + 0.2 * inst)
+            self._publish(plan.batch_size, len(plan.preempted), dt)
+            return {
+                "batch_size": plan.batch_size,
+                "prefills": len(plan.prefills),
+                "decodes": len(plan.decodes),
+                "preempted": len(plan.preempted),
+                "finished": len(finished),
+                "finished_ids": [s.seq_id for s in finished],
+                "tokens": n_tokens,
+                "step_s": dt,
+            }
+
+    def _finish_buffer(self, seq: Sequence):
+        buf = self._out.get(seq.seq_id)
+        if buf is not None:
+            buf.done = True
+            buf.finish_reason = seq.finish_reason
+
+    def _publish(self, batch: int, preempted: int, dt: float):
+        try:
+            m = _metrics()
+            m["tokens_per_s"].set(self._tokens_per_s, tags=self._tags)
+            m["kv_util"].set(self.cache.utilization(), tags=self._tags)
+            m["batch"].set(batch, tags=self._tags)
+            if preempted:
+                m["preempt"].inc(preempted, tags=self._tags)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ misc
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.scheduler.has_work()
+
+    def load(self) -> int:
+        """Waiting + running sequences — what the serve autoscaler keys on."""
+        with self._lock:
+            return self.scheduler.queue_depth()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "waiting": len(self.scheduler.waiting),
+                "running": len(self.scheduler.running),
+                "kv_utilization": round(self.cache.utilization(), 4),
+                "kv_free_blocks": self.cache.num_free_blocks,
+                "tokens_per_s": round(self._tokens_per_s, 1),
+                "tokens_total": self.tokens_total,
+                "steps_total": self.steps_total,
+                "preemptions_total": self.scheduler.preemptions_total,
+                "finished_total": self.scheduler.finished_total,
+            }
+
+    def run_until_drained(self, max_steps: int = 1_000_000) -> int:
+        """Drive the engine until no work remains (bench/test helper);
+        returns steps executed."""
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+
+def _normalize_prompt(prompt: Union[str, bytes, List[int]]) -> List[int]:
+    """str prompts become UTF-8 byte ids (every zoo vocab is >= 256);
+    token-id lists / arrays pass through."""
+    if isinstance(prompt, str):
+        return list(prompt.encode("utf-8"))
+    if isinstance(prompt, (bytes, bytearray)):
+        return list(np.frombuffer(bytes(prompt), dtype=np.int32))
+    return [int(t) for t in prompt]
+
+
+class LLMReplica:
+    """The deployment class: ``serve.llm.deploy`` binds this behind serve.
+
+    One background task pumps the engine; every request-facing method is
+    async and cheap (the model math runs in the executor). Telemetry
+    identity (deployment/replica tags) is injected by the hosting
+    ``Replica`` via ``__serve_identity__``; the serve autoscaler reads the
+    engine's queue depth via ``__serve_load__``.
+    """
+
+    def __init__(
+        self,
+        model: str = "gpt2-tiny",
+        model_config: Optional[dict] = None,
+        *,
+        num_blocks: Optional[int] = None,
+        block_size: Optional[int] = None,
+        max_batch: Optional[int] = None,
+        max_waiting: Optional[int] = None,
+        seed: int = 0,
+    ):
+        adapter = build_adapter(model, model_config, seed=seed)
+        self.engine = LLMEngine(
+            adapter,
+            num_blocks=num_blocks,
+            block_size=block_size,
+            max_batch=max_batch,
+            max_waiting=max_waiting,
+        )
+        self.model = model
+        self._loop_task = None
+        self._tick = None          # asyncio.Event, re-armed every step
+        self._wake = None          # set on submit while the loop is idle
+
+    # hooks the serve Replica wrapper calls (see serve/_replica.py)
+    def __serve_identity__(self, deployment: str, replica: str):
+        self.engine.set_identity(deployment, replica)
+
+    def __serve_load__(self) -> int:
+        return self.engine.load()
+
+    # ------------------------------------------------------------- step loop
+
+    def _ensure_loop(self):
+        import asyncio
+
+        if self._loop_task is not None and not self._loop_task.done():
+            return
+        self._tick = asyncio.Event()
+        self._wake = asyncio.Event()
+        self._loop_task = asyncio.ensure_future(self._run_loop())
+
+    async def _run_loop(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        while True:
+            if self.engine.has_work():
+                await loop.run_in_executor(None, self.engine.step)
+                # wake every pull waiting on this step's tokens
+                tick, self._tick = self._tick, asyncio.Event()
+                tick.set()
+            else:
+                self._wake.clear()
+                # wake promptly on submit; the timeout keeps the loop
+                # resilient to a lost wake (cancelled submit etc.)
+                try:
+                    await asyncio.wait_for(self._wake.wait(), 1.0)
+                except asyncio.TimeoutError:
+                    pass
+
+    @staticmethod
+    async def _wait_event(ev, timeout: float):
+        import asyncio
+
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+
+    # -------------------------------------------------------- request surface
+
+    def _submit(self, prompt, sampling: Optional[dict]) -> str:
+        sp = SamplingParams(**(sampling or {}))
+        rid = self.engine.submit(_normalize_prompt(prompt), sp)
+        self._ensure_loop()
+        self._wake.set()
+        return rid
+
+    async def llm_submit(self, prompt, sampling: Optional[dict] = None) -> dict:
+        """OOB ingress entry: prompt may be raw int32 bytes (the frame's
+        payload, untouched), a token-id list, or a string."""
+        self._ensure_loop()
+        return {"request_id": self._submit(prompt, sampling)}
+
+    async def llm_pull(self, request_id: str, max_tokens: int = 0,
+                       wait_s: Optional[float] = None) -> dict:
+        """Long-poll pull: waits up to ``wait_s`` for at least one token
+        (or completion), then returns ``{"tokens": <raw int32 bytes>,
+        "done", "finish_reason"}`` — bytes, so the proxy can forward them
+        as an OOB frame without re-serializing."""
+        import time as _time
+
+        self._ensure_loop()
+        if wait_s is None:
+            wait_s = float(RTPU_CONFIG.llm_pull_wait_s)
+        deadline = _time.monotonic() + max(0.0, float(wait_s))
+        while True:
+            # grab the CURRENT tick event before reading the buffer: a step
+            # landing between the read and the wait sets this very event,
+            # so the wait below returns immediately instead of timing out
+            ev = self._tick
+            try:
+                toks, done, reason = self.engine.pull(request_id, max_tokens)
+            except KeyError:
+                return {"tokens": b"", "done": True,
+                        "finish_reason": "unknown"}
+            if toks or done or _time.monotonic() >= deadline:
+                return {
+                    "tokens": np.asarray(toks, dtype=np.int32).tobytes(),
+                    "done": done,
+                    "finish_reason": reason,
+                }
+            await self._wait_event(ev, max(0.01,
+                                           deadline - _time.monotonic()))
+
+    async def llm_cancel(self, request_id: str) -> dict:
+        ok = self.engine.cancel(request_id)
+        if self._wake is not None:
+            self._wake.set()  # let the loop reap + free the KV promptly
+        return {"ok": ok}
+
+    async def generate(self, prompt, **sampling) -> dict:
+        """One-shot completion through the same continuous-batching path."""
+        self._ensure_loop()
+        rid = self._submit(prompt, sampling)
+        tokens: List[int] = []
+        while True:
+            out = await self.llm_pull(rid, wait_s=30.0)
+            tokens.extend(np.frombuffer(out["tokens"], dtype=np.int32)
+                          .tolist())
+            if out["done"]:
+                return {"tokens": tokens,
+                        "finish_reason": out["finish_reason"]}
+
+    async def stream(self, prompt, **sampling):
+        """Async generator of token ids — rides serve's generic streaming
+        (handle ``options(stream=True)`` and the HTTP ``?stream=1`` path)."""
+        self._ensure_loop()
+        rid = self._submit(prompt, sampling)
+        try:
+            while True:
+                out = await self.llm_pull(rid, wait_s=30.0)
+                for t in np.frombuffer(out["tokens"], dtype=np.int32):
+                    yield int(t)
+                if out["done"]:
+                    return
+        finally:
+            # generator abandoned mid-stream (client closed): free the KV
+            self.engine.cancel(rid)
+
+    async def __call__(self, prompt, **sampling) -> dict:
+        return await self.generate(prompt, **sampling)
+
+    async def stats(self) -> dict:
+        return {"model": self.model, **self.engine.stats()}
+
+    def check_health(self):
+        if self._loop_task is not None and self._loop_task.done():
+            exc = self._loop_task.exception()
+            raise RuntimeError(f"llm step loop died: {exc!r}")
+        return True

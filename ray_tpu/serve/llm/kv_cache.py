@@ -1,0 +1,197 @@
+"""Paged KV cache: fixed-size blocks + per-sequence block tables.
+
+The vLLM insight applied to this engine: a sequence's KV never needs to be
+contiguous — it lives in fixed-size blocks handed out from one shared pool,
+so admitting a request costs exactly ``ceil(prompt_len / block_size)``
+blocks instead of a max-context reservation, and a finished or cancelled
+sequence returns its blocks to the pool immediately.
+
+Storage is plain numpy (fp32), one (K, V) pair of
+``[n_layers, num_blocks, block_size, n_kv_heads, head_dim]`` arrays: the
+decode adapters (``adapters.py``) are numpy too, which keeps the whole
+engine runnable on the CPU plane (``JAX_PLATFORMS=cpu``) where tier-1 and
+the ``serve_llm_tokens_per_s`` bench exercise it. On a TPU replica the
+same block-table bookkeeping would drive a pallas paged-attention kernel;
+the allocator below is deliberately math-free so that swap stays local to
+the adapter.
+
+Thread-unsafe by design: the engine serializes all cache access behind its
+step loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class KVCacheExhausted(RuntimeError):
+    """Raised only by callers that choose to treat a failed allocation as
+    fatal; the scheduler uses the boolean returns instead (preempting is
+    its job, not the allocator's)."""
+
+
+class PagedKVCache:
+    """Block allocator + per-sequence block tables + the backing arrays.
+
+    A sequence's logical KV layout: token position ``t`` lives at
+    ``block_table[t // block_size]``, offset ``t % block_size``.
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        n_layers: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=np.float32,
+    ):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.n_layers = int(n_layers)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        shape = (self.n_layers, self.num_blocks, self.block_size,
+                 self.n_kv_heads, self.head_dim)
+        self.k = np.zeros(shape, dtype=dtype)
+        self.v = np.zeros(shape, dtype=dtype)
+        # LIFO free list: recently freed blocks are cache-warm.
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self.block_tables: Dict[str, List[int]] = {}
+        self.seq_lens: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ accounting
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def utilization(self) -> float:
+        """Fraction of the pool currently allocated (the
+        ``ray_tpu_llm_kv_utilization`` gauge)."""
+        return self.num_used_blocks / self.num_blocks
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.blocks_needed(n_tokens) <= len(self._free)
+
+    # ------------------------------------------------------------ allocation
+
+    def allocate(self, seq_id: str, n_tokens: int) -> bool:
+        """Reserve blocks for a new sequence of ``n_tokens`` (its prompt).
+        False (and no state change) when the pool cannot cover it."""
+        if seq_id in self.block_tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_needed(max(1, n_tokens))
+        if need > len(self._free):
+            return False
+        self.block_tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self.seq_lens[seq_id] = 0
+        return True
+
+    def extend(self, seq_id: str, n_tokens: int = 1) -> bool:
+        """Ensure capacity for ``n_tokens`` more positions, allocating new
+        blocks at the table's tail when the last block is full. False when
+        the pool is exhausted (caller preempts); partial growth is rolled
+        back so a failed extend is side-effect free."""
+        table = self.block_tables[seq_id]
+        have = len(table) * self.block_size - self.seq_lens[seq_id]
+        need_blocks = self.blocks_needed(max(0, n_tokens - have)) \
+            if n_tokens > have else 0
+        if need_blocks > len(self._free):
+            return False
+        for _ in range(need_blocks):
+            table.append(self._free.pop())
+        return True
+
+    def free(self, seq_id: str) -> int:
+        """Return the sequence's blocks to the pool; returns how many."""
+        table = self.block_tables.pop(seq_id, None)
+        self.seq_lens.pop(seq_id, None)
+        if not table:
+            return 0
+        self._free.extend(reversed(table))
+        return len(table)
+
+    # ---------------------------------------------------------------- writes
+
+    def _slots(self, seq_id: str, start: int, n: int):
+        """(block_ids, offsets) arrays for logical positions [start, start+n)."""
+        table = self.block_tables[seq_id]
+        pos = np.arange(start, start + n)
+        return np.asarray(table, dtype=np.int64)[pos // self.block_size], \
+            pos % self.block_size
+
+    def write_prefill(self, seq_id: str, k: np.ndarray, v: np.ndarray):
+        """Copy-on-admit prefill write: ``k``/``v`` are
+        ``[n_layers, T, n_kv_heads, head_dim]`` for the whole prompt; the
+        copy into the paged arrays happens exactly once, here."""
+        T = k.shape[1]
+        if not self.extend(seq_id, T):
+            raise KVCacheExhausted(f"prefill of {T} tokens does not fit")
+        blocks, offs = self._slots(seq_id, self.seq_lens[seq_id], T)
+        self.k[:, blocks, offs] = k
+        self.v[:, blocks, offs] = v
+        self.seq_lens[seq_id] += T
+
+    def append(self, seq_id: str, k: np.ndarray, v: np.ndarray):
+        """Write one decoded token's ``[n_layers, n_kv_heads, head_dim]``
+        K/V at the sequence's current length. The slot must already exist
+        (``extend`` ran in the schedule phase)."""
+        pos = self.seq_lens[seq_id]
+        table = self.block_tables[seq_id]
+        block = table[pos // self.block_size]
+        off = pos % self.block_size
+        self.k[:, block, off] = k
+        self.v[:, block, off] = v
+        self.seq_lens[seq_id] = pos + 1
+
+    # ---------------------------------------------------------------- reads
+
+    def gather(self, seq_id: str) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense ``[n_layers, T, heads, dim]`` views of one sequence's KV
+        (copies out of the paged arrays — the CPU analogue of what a paged
+        attention kernel reads in place)."""
+        T = self.seq_lens[seq_id]
+        blocks, offs = self._slots(seq_id, 0, T)
+        return self.k[:, blocks, offs], self.v[:, blocks, offs]
+
+    def gather_batch(
+        self, seq_ids: List[str], pad_to: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Padded batch gather for the fused decode step: returns
+        ``(k [B, L, Tmax, H, D], v same, lens [B])``; positions past a
+        sequence's length are zero (the adapter masks by ``lens``)."""
+        lens = np.asarray([self.seq_lens[s] for s in seq_ids], dtype=np.int32)
+        tmax = max(int(lens.max(initial=0)), 1)
+        if pad_to is not None:
+            tmax = max(tmax, pad_to)
+        B = len(seq_ids)
+        # one vectorized fancy-index per array instead of a per-sequence
+        # copy loop: build [B, Tmax] (block, offset) index grids (padding
+        # positions point at block 0 and are masked by `lens` downstream)
+        pos = np.arange(tmax)
+        off = np.broadcast_to(pos % self.block_size, (B, tmax))
+        blk = np.zeros((B, tmax), dtype=np.int64)
+        for i, s in enumerate(seq_ids):
+            t = int(lens[i])
+            if t:
+                blk[i, :t] = np.asarray(self.block_tables[s],
+                                        dtype=np.int64)[pos[:t]
+                                                        // self.block_size]
+        # [L, B, T, H, D] -> [B, L, T, H, D]
+        k = np.moveaxis(self.k[:, blk, off], 0, 1)
+        v = np.moveaxis(self.v[:, blk, off], 0, 1)
+        # padding rows beyond a sequence's length carry stale block-0 data;
+        # the adapters mask attention by `lens`, so zeroing is unnecessary
+        return k, v, lens

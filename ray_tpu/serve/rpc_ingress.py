@@ -26,6 +26,19 @@ class RpcIngressError(RuntimeError):
     pass
 
 
+class RpcBackpressureError(RpcIngressError):
+    """Admission rejected by the llm engine (structured shed-load reply,
+    serve/llm admission control): carries the numbers a client needs to
+    back off sensibly instead of hammering a saturated replica."""
+
+    def __init__(self, message: str, queue_depth: int = 0,
+                 max_waiting: int = 0, kv_utilization: float = 0.0):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.max_waiting = max_waiting
+        self.kv_utilization = kv_utilization
+
+
 class RpcIngressClient:
     def __init__(self, host: str, port: int):
         self._io = IoThread.current()
@@ -73,6 +86,40 @@ class RpcIngressClient:
             raise RpcIngressError(reply["error"])
         return RpcStream(self, reply["stream_id"], timeout,
                          max_items_per_pull)
+
+    def llm_stream(self, prompt, *, app: str = "llm", timeout: float = 300.0,
+                   max_tokens_per_pull: int = 0, **sampling) -> "LlmStream":
+        """Open a continuous-batching generation stream (serve/llm).
+
+        The prompt ships as ONE raw out-of-band frame of int32 token ids
+        (str prompts become UTF-8 byte ids) and token deltas come back the
+        same way — the proxy never re-serializes either direction.
+        ``sampling``: max_tokens, temperature, top_k, eos_id, seed.
+        Raises :class:`RpcBackpressureError` when admission is shed.
+        """
+        import numpy as np
+
+        if isinstance(prompt, str):
+            ids = np.asarray(list(prompt.encode("utf-8")), dtype=np.int32)
+        else:
+            ids = np.asarray(list(prompt), dtype=np.int32)
+        req = {"app": app, "timeout": timeout, "sampling": sampling}
+        reply = self._io.run(
+            self._client.call("ServeLlmOpen", req, timeout=timeout,
+                              oob=ids.tobytes()),
+            timeout=timeout + 10,
+        )
+        if reply.get("error"):
+            if reply.get("backpressure"):
+                raise RpcBackpressureError(
+                    reply["error"],
+                    queue_depth=reply.get("queue_depth", 0),
+                    max_waiting=reply.get("max_waiting", 0),
+                    kv_utilization=reply.get("kv_utilization", 0.0),
+                )
+            raise RpcIngressError(reply["error"])
+        return LlmStream(self, reply["stream_id"], timeout,
+                         max_tokens_per_pull)
 
     def close(self):
         try:
@@ -135,5 +182,99 @@ class RpcStream:
                 ),
                 timeout=15,
             )
+        except Exception:
+            pass
+
+
+class LlmStream:
+    """Client side of a serve/llm token stream: iterate (or async-iterate)
+    int token ids. Each pull is one ``ServeLlmNext`` round-trip whose token
+    payload arrives as a raw out-of-band frame (int32 little-endian) —
+    decoded here with one ``np.frombuffer``, zero copies upstream of the
+    socket. ``finish_reason`` is set once the stream ends."""
+
+    def __init__(self, client: RpcIngressClient, stream_id: str,
+                 timeout: float, max_tokens_per_pull: int = 0):
+        self._client = client
+        self._sid = stream_id
+        self._timeout = timeout
+        self._max_tokens = max_tokens_per_pull
+        self._buf: list = []
+        self._done = False
+        self._owns_client = False
+        self.finish_reason: str | None = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        import numpy as np
+
+        while not self._buf:
+            if self._done:
+                self._finish()
+                raise StopIteration
+            reply = self._client._io.run(
+                self._client._client.call(
+                    "ServeLlmNext",
+                    {"stream_id": self._sid,
+                     "max_tokens": self._max_tokens},
+                    timeout=self._timeout,
+                ),
+                timeout=self._timeout + 10,
+            )
+            if reply.get("error"):
+                self._done = True
+                self._finish()
+                raise RpcIngressError(reply["error"])
+            raw = reply.get("_oob") or b""
+            self._buf.extend(np.frombuffer(bytes(raw), dtype=np.int32)
+                             .tolist())
+            self._done = reply["done"]
+            if self._done:
+                self.finish_reason = reply.get("finish_reason")
+        return self._buf.pop(0)
+
+    # async iteration: the blocking pull runs in the default executor so
+    # `async for tok in serve.llm.stream(...)` works from an event loop
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        import asyncio
+
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def _finish(self):
+        if self._owns_client and self._client is not None:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+            self._client = None
+
+    def close(self):
+        """Abandon mid-stream: the proxy cancels the sequence so its KV
+        blocks return to the pool immediately."""
+        if not self._done:
+            self._done = True
+            try:
+                self._client._io.run(
+                    self._client._client.call(
+                        "ServeLlmCancel", {"stream_id": self._sid},
+                        timeout=10),
+                    timeout=15,
+                )
+            except Exception:
+                pass
+        self._finish()
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:
             pass

@@ -34,6 +34,16 @@ change (add new series instead). The stable set:
     ray_tpu_serve_handle_latency_seconds         histogram (caller-side)
     ray_tpu_serve_handle_requests_total          counter
 
+  llm serving (serve/llm/engine.py, labels: deployment, replica)
+    ray_tpu_llm_tokens_per_s           gauge, generated tokens/s (EMA
+                                       over engine steps)
+    ray_tpu_llm_kv_utilization         gauge, 0-1 fraction of paged KV
+                                       blocks in use
+    ray_tpu_llm_batch_size             gauge, sequences in the last
+                                       engine step
+    ray_tpu_llm_preemptions_total      counter, sequences requeued on KV
+                                       exhaustion
+
   profiling plane (_private/watchdog.py, labels: trigger — the incident
   kind or trigger that caused the capture: slow_step, stuck_task, ...)
     ray_tpu_profile_captures_total               counter, automatic
@@ -62,9 +72,10 @@ change (add new series instead). The stable set:
                                        node (worker = sum over workers)
 
 The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* /
-RTPU_memory_* config flags are likewise a stability contract — see the
-profiling-plane, perf-regression-plane and memory-observability-plane
-sections of ``ray_tpu/_private/config.py``.
+RTPU_memory_* / RTPU_llm_* config flags are likewise a stability
+contract — see the profiling-plane, perf-regression-plane,
+memory-observability-plane and serve.llm sections of
+``ray_tpu/_private/config.py``.
 """
 
 from __future__ import annotations
