@@ -194,6 +194,134 @@ def _bench_serve_llm(quick: bool, reps: int) -> dict:
     return out
 
 
+def _record_rows(rows: dict, reps: int) -> dict:
+    """Fold per-rep lists into the _REP_DETAIL median protocol."""
+    out = {}
+    for key, vals in rows.items():
+        vals = sorted(vals)
+        med = vals[len(vals) // 2]
+        _REP_DETAIL[key] = {"min": vals[0], "median": med, "max": vals[-1],
+                            "reps": reps}
+        out[key] = med
+        print(f"  {key}: {med:,.3f}" if med < 10 else f"  {key}: {med:,.1f}")
+    return out
+
+
+def _bench_serve_llm_prefix(quick: bool, reps: int) -> dict:
+    """Prefix-caching A/B at a high prompt-overlap mix: every stream's
+    prompt is one shared ~96-token system prefix plus a short unique tail
+    (>= 0.9 overlap — the million-users-one-template serving shape), run
+    once with the prefix cache on and once cold on the SAME gpt2-tiny
+    adapter/engine config. The warm run prefills only each tail, so the
+    ratio isolates exactly what copy-on-write block sharing buys;
+    `serve_llm_prefix_kv_hit_rate` (0-1, higher is better) gates the
+    matcher itself — a hashing/registration regression shows up here even
+    if throughput noise hides it.
+    """
+    import time as _time
+
+    from ray_tpu.serve.llm.adapters import build_adapter
+    from ray_tpu.serve.llm.engine import LLMEngine, SamplingParams
+
+    # quick keeps the FULL workload geometry and only drops reps: the
+    # admitted-cold fraction — and with it the tightly-banded hit-rate row
+    # and the per-step throughput — must stay comparable to the full-mode
+    # ledger baseline, and this section costs seconds, not minutes
+    n_streams, max_batch = 96, 16
+    adapter = build_adapter(
+        "gpt2-tiny",
+        {"n_layer": 2, "n_embd": 64, "n_head": 4, "vocab_size": 512,
+         "block_size": 256, "use_flash_attention": False},
+        seed=0)
+    rng = np.random.default_rng(1)
+    shared = rng.integers(0, 512, 96).tolist()          # the system prompt
+    prompts = [shared + rng.integers(0, 512, int(rng.integers(4, 9))).tolist()
+               for _ in range(n_streams)]
+    max_toks = rng.integers(8, 17, n_streams)
+    total_tokens = int(max_toks.sum())
+
+    def run(prefix_cache: bool):
+        eng = LLMEngine(adapter, num_blocks=4096, block_size=16,
+                        max_batch=max_batch, max_waiting=n_streams + 1,
+                        prefix_cache=prefix_cache)
+        t0 = _time.perf_counter()
+        for p, m in zip(prompts, max_toks):
+            eng.submit(p, SamplingParams(max_tokens=int(m)))
+        eng.run_until_drained()
+        return total_tokens / (_time.perf_counter() - t0), \
+            eng.cache.hit_rate()
+
+    run(prefix_cache=True)   # untimed warmup: page-fault/alloc state
+    warm, cold, hits = [], [], []
+    for _ in range(reps):
+        w, h = run(prefix_cache=True)
+        warm.append(w)
+        hits.append(h)
+        cold.append(run(prefix_cache=False)[0])
+    out = _record_rows({"serve_llm_prefix_tokens_per_s": warm,
+                        "serve_llm_prefix_cold_tokens_per_s": cold,
+                        "serve_llm_prefix_kv_hit_rate": hits}, reps)
+    print(f"  serve_llm prefix warm/cold ratio: "
+          f"{out['serve_llm_prefix_tokens_per_s'] / out['serve_llm_prefix_cold_tokens_per_s']:.2f} "
+          f"({n_streams} streams, ~0.93 overlap)")
+    return out
+
+
+def _bench_serve_llm_spec(quick: bool, reps: int) -> dict:
+    """Speculative-decoding A/B on the deterministic fake adapter with a
+    modeled 10:1 target:draft step cost (the Gemma-31B-vs-2B serving
+    shape, `step_cost_s` sleeps once per fused call like one accelerator
+    dispatch) and a draft that deterministically disagrees on ~1/7 of
+    positions. The row gates the ENGINE's propose/verify/rollback
+    machinery and its overhead — model quality is fixed by construction,
+    so `serve_llm_spec_acceptance` (0-1, higher is better) is a tight
+    regression tripwire for the acceptance logic itself. The real-model
+    correctness bar (byte-equality vs non-speculative greedy on gpt2 and
+    llama) lives in tests/test_llm_prefix_spec.py.
+    """
+    import time as _time
+
+    from ray_tpu.serve.llm.adapters import FakeAdapter
+    from ray_tpu.serve.llm.engine import LLMEngine, SamplingParams
+
+    n_streams = 16 if quick else 32
+    max_tokens = 64
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 97, int(rng.integers(4, 9))).tolist()
+               for _ in range(n_streams)]
+    total_tokens = n_streams * max_tokens
+
+    def run(spec: bool):
+        eng = LLMEngine(
+            FakeAdapter(vocab_size=97, step_cost_s=5e-3),
+            num_blocks=2048, block_size=16, max_batch=8,
+            max_waiting=n_streams + 1, prefix_cache=False,
+            draft_adapter=(FakeAdapter(vocab_size=97, step_cost_s=5e-4,
+                                       disagree_every=7) if spec else None),
+            spec_k=4)
+        t0 = _time.perf_counter()
+        for p in prompts:
+            eng.submit(p, SamplingParams(max_tokens=max_tokens))
+        eng.run_until_drained()
+        return total_tokens / (_time.perf_counter() - t0), \
+            eng.spec_acceptance()
+
+    run(spec=True)           # untimed warmup (same reason as prefix)
+    fast, base, acc = [], [], []
+    for _ in range(reps):
+        f, a = run(spec=True)
+        fast.append(f)
+        acc.append(a)
+        base.append(run(spec=False)[0])
+    out = _record_rows({"serve_llm_spec_tokens_per_s": fast,
+                        "serve_llm_spec_baseline_tokens_per_s": base,
+                        "serve_llm_spec_acceptance": acc}, reps)
+    print(f"  serve_llm spec/baseline ratio: "
+          f"{out['serve_llm_spec_tokens_per_s'] / out['serve_llm_spec_baseline_tokens_per_s']:.2f} "
+          f"(k=4, 10:1 cost model)")
+    return out
+
+
 def _define_remotes():
     import ray_tpu
 
@@ -263,6 +391,14 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
             or sel("serve_llm_static_batch_tokens_per_s")
             or sel("serve_llm_stream_p99_ms")):
         results.update(_bench_serve_llm(quick, reps=_REPS))
+    if (sel("serve_llm_prefix_tokens_per_s")
+            or sel("serve_llm_prefix_cold_tokens_per_s")
+            or sel("serve_llm_prefix_kv_hit_rate")):
+        results.update(_bench_serve_llm_prefix(quick, reps=_REPS))
+    if (sel("serve_llm_spec_tokens_per_s")
+            or sel("serve_llm_spec_baseline_tokens_per_s")
+            or sel("serve_llm_spec_acceptance")):
+        results.update(_bench_serve_llm_spec(quick, reps=_REPS))
     cluster_metrics = (
         "single_client_tasks_sync", "single_client_tasks_async",
         "wait_1k_refs", "multi_client_tasks_async", "1_1_actor_calls_sync",
@@ -272,7 +408,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
         "placement_group_create_removal",
     )
     if not any(sel(m) for m in cluster_metrics):
-        return {k: round(v, 1) for k, v in results.items()}
+        return {k: round(v, 3 if abs(v) < 10 else 1)
+            for k, v in results.items()}
 
     ray_tpu.init(num_cpus=8)
     try:
@@ -404,7 +541,8 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
                 key="placement_group_create_removal")
     finally:
         ray_tpu.shutdown()
-    return {k: round(v, 1) for k, v in results.items()}
+    return {k: round(v, 3 if abs(v) < 10 else 1)
+        for k, v in results.items()}
 
 
 def run_quick() -> dict:
@@ -504,6 +642,8 @@ def main():
         "| single_client_put_gigabytes | ±45% | ±30% | store page-fault state (cold ~2.1 vs steady 6.7 GiB/s) |",
         "| wait_1k_refs | ±45% | ±30% | timer batching across the submit window |",
         "| serve_llm_* | ±45% | ±30% | multi-second numpy run: allocator/GC state; p99 row is LOWER-is-better (gate inverts) |",
+        "| serve_llm_prefix_kv_hit_rate | ±15% | ±10% | 0-1 ratio over a deterministic prompt mix (higher is better) |",
+        "| serve_llm_spec_acceptance | ±15% | ±10% | 0-1 ratio, deterministic draft disagreement (higher is better) |",
         "",
         "The committed trajectory lives in `PERF_HISTORY.jsonl` (append with",
         "`ray-tpu perf check --update` when refreshing this table);",

@@ -209,11 +209,26 @@ _FLAGS: Dict[str, Any] = {
     #                         cache
     #   llm_pull_wait_s       long-poll window of a token pull (the stream
     #                         ingress re-pulls after an empty reply)
+    #   llm_prefix_cache      share full prompt blocks between sequences
+    #                         (chained content hash + copy-on-write block
+    #                         tables); admission then only prefills the
+    #                         un-hit tail. Outputs stay byte-equal to the
+    #                         uncached path; 0 disables (cold cache)
+    #   llm_spec_k            draft tokens proposed per speculative-decode
+    #                         step (verified by the target model in one
+    #                         fused forward); only greedy sequences
+    #                         speculate. 0 disables even with a draft
+    #   llm_draft_model       zoo name of the draft model every LLMReplica
+    #                         loads for speculative decoding ("" = off;
+    #                         per-deploy `draft_model=` overrides)
     "llm_block_size": 16,
     "llm_num_blocks": 1024,
     "llm_max_batch": 32,
     "llm_max_waiting": 512,
     "llm_pull_wait_s": 2.0,
+    "llm_prefix_cache": True,
+    "llm_spec_k": 4,
+    "llm_draft_model": "",
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

@@ -61,6 +61,13 @@ bytes, hex-encoded at dump time) and a short detail string/number.
   train.step                     one (multi-)step dispatch recorded by the
                                  train telemetry layer
   serve.request                  one replica-side serve request finished
+  llm.admit / llm.preempt / llm.finish   serve/llm engine sequence
+                                 lifecycle (admit carries the prompt
+                                 length + prefix-hit token count)
+  llm.prefix_hit                 a prefix-cache hit at admission:
+                                 "<seq> hit=<tokens>/<context>"
+  llm.spec_verify                one speculative verify round:
+                                 "batch=<B> k=<proposed> accepted=<n>"
   incident.open                  the GCS accepted an incident record
   watchdog.fire                  a stall watchdog tripped locally
 """

@@ -69,6 +69,18 @@ _METRIC_BANDS: Dict[str, Dict[int, float]] = {
     "serve_llm_tokens_per_s": {1: 0.45, 3: 0.30},
     "serve_llm_static_batch_tokens_per_s": {1: 0.45, 3: 0.30},
     "serve_llm_stream_p99_ms": {1: 0.45, 3: 0.30},
+    # prefix-caching / speculative-decoding A/B rows (same engine runs)
+    "serve_llm_prefix_tokens_per_s": {1: 0.45, 3: 0.30},
+    "serve_llm_prefix_cold_tokens_per_s": {1: 0.45, 3: 0.30},
+    "serve_llm_spec_tokens_per_s": {1: 0.45, 3: 0.30},
+    "serve_llm_spec_baseline_tokens_per_s": {1: 0.45, 3: 0.30},
+    # ...but the hit-rate and acceptance rows are 0-1 RATIOS (higher is
+    # better, like throughput) over deterministic workloads — a scheduler
+    # admission-order wiggle moves them a little, a matcher/acceptance
+    # regression moves them a lot, so they get far tighter bands than the
+    # wall-clock rows
+    "serve_llm_prefix_kv_hit_rate": {1: 0.15, 3: 0.10},
+    "serve_llm_spec_acceptance": {1: 0.15, 3: 0.10},
 }
 
 # Metrics where LOWER is better (latencies): the gate inverts the verdict —

@@ -12,6 +12,16 @@ engine in the vLLM/Orca mold —
     step admits new prompts into spare batch slots (prefill), runs ONE
     fused decode step for every active sequence, and preempts-and-requeues
     the youngest sequence when the cache runs out of blocks;
+  - **prefix caching** (``RTPU_llm_prefix_cache``): full prompt blocks are
+    indexed by chained content hash and shared copy-on-write between
+    sequences, so a million users on one system prompt store one KV copy
+    and only their unique tails are prefilled — byte-equal to the cold
+    path, measured by the ``serve_llm_prefix_*`` bench rows;
+  - **speculative decoding** (``RTPU_llm_draft_model`` +
+    ``RTPU_llm_spec_k``): a tiny draft model proposes ``k`` tokens, the
+    target verifies them in one fused forward and keeps the longest
+    agreeing run (+1 bonus token) — greedy acceptance keeps the stream
+    exactly what the target alone would produce;
   - **admission control**: past ``RTPU_llm_max_waiting`` queued prompts
     the engine sheds load with a structured ``LLMBackpressure`` error
     (carrying queue depth + KV utilization) instead of OOMing the cache;
@@ -80,8 +90,11 @@ def deploy(
     ``model`` names a zoo entry (``gpt2-tiny``, ``gpt2``, ``llama-tiny``,
     ``llama-160m``, ``gpt2-moe-tiny``); ``model_config`` overrides config
     fields. ``engine_kwargs`` (``num_blocks``, ``block_size``,
-    ``max_batch``, ``max_waiting``) override the ``RTPU_llm_*`` flags.
-    Returns the app's DeploymentHandle.
+    ``max_batch``, ``max_waiting``, ``prefix_cache``, ``draft_model``,
+    ``draft_model_config``, ``spec_k``) override the ``RTPU_llm_*`` flags —
+    e.g. ``draft_model="gpt2-tiny", spec_k=4`` turns on speculative
+    decoding with that zoo model as the draft. Returns the app's
+    DeploymentHandle.
     """
     from ray_tpu import serve
 

@@ -6,13 +6,24 @@ with no KV cache plumbing, so the adapters re-express their forward pass as
 explicit numpy math over the raw param pytrees in two shapes the engine
 needs:
 
-  ``prefill(tokens)``  one sequence's full context: returns the last
-                       position's logits plus per-layer K/V for every
-                       position (the copy-on-admit cache write);
-  ``decode(...)``      ONE fused step for the whole running batch: each
-                       sequence contributes one new token + its gathered
-                       paged KV; returns next-token logits and the new
-                       token's K/V to append.
+  ``prefill(tokens)``       one sequence's full context: returns the last
+                            position's logits plus per-layer K/V for every
+                            position (the copy-on-admit cache write);
+  ``prefill_ctx(...)``      the chunked form: run only the un-cached TAIL
+                            of a context against KV the prefix cache
+                            already holds (``prefill`` is the start=0
+                            special case — the two share one code path so
+                            a prefix hit cannot drift numerically);
+  ``decode(...)``           ONE fused step for the whole running batch:
+                            each sequence contributes one new token + its
+                            gathered paged KV; returns next-token logits
+                            and the new token's K/V to append;
+  ``decode_chunk(...)``     the speculative-verify form: each sequence
+                            contributes a short chunk (last sampled token
+                            + the draft's proposals) scored in ONE fused
+                            forward — logits for every chunk position, so
+                            the engine can accept the longest agreeing
+                            run and take the bonus token.
 
 Everything is fp32 numpy — bit-for-bit deterministic, chip-free (tier-1
 and the CPU-plane bench run the real engine), and byte-equivalent to the
@@ -86,12 +97,42 @@ def _attend(q, k_ctx, v_ctx, lens, k_new, v_new):
     return out + probs[..., Tmax:] * v_new
 
 
-def _causal_attend(q, k, v):
-    """Prefill self-attention, one sequence: q/k/v ``[T, H, D]``."""
+def _ctx_causal_attend(q, k_ctx, v_ctx, k_ch, v_ch):
+    """Chunked prefill attention, one sequence: the chunk's queries
+    ``q [T, H, D]`` attend to the already-cached context ``k_ctx/v_ctx
+    [P, H, D]`` plus causally to the chunk itself (``k_ch/v_ch [T, H, D]``).
+    With ``P == 0`` this is exactly full-prefill self-attention: the empty
+    context contributes zero to both the softmax and the output, so
+    ``prefill`` and a prefix-cache-hit tail prefill share one code path."""
     T, H, D = q.shape
-    s = np.einsum("thd,shd->hts", q, k) / math.sqrt(D)
-    s = np.where(np.tril(np.ones((T, T), dtype=bool))[None], s, -1e30)
-    return np.einsum("hts,shd->thd", _softmax(s), v)
+    P = k_ctx.shape[0]
+    s_ctx = np.einsum("thd,shd->hts", q, k_ctx) / math.sqrt(D)
+    s_ch = np.einsum("thd,shd->hts", q, k_ch) / math.sqrt(D)
+    s_ch = np.where(np.tril(np.ones((T, T), dtype=bool))[None], s_ch, -1e30)
+    probs = _softmax(np.concatenate([s_ctx, s_ch], axis=-1))
+    return np.einsum("hts,shd->thd", probs[..., :P], v_ctx) \
+        + np.einsum("hts,shd->thd", probs[..., P:], v_ch)
+
+
+def _chunk_attend(q, k_ctx, v_ctx, lens, k_ch, v_ch):
+    """Fused multi-token verify attention over (paged-gathered context +
+    causal chunk), the batched C>1 sibling of :func:`_attend`.
+
+    q/k_ch/v_ch ``[B, C, H, D]``; k_ctx/v_ctx ``[B, Tmax, H, D]`` padded
+    past ``lens [B]``. Returns ``[B, C, H, D]``.
+    """
+    B, Tmax, H, D = k_ctx.shape
+    C = q.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    s_ctx = np.einsum("bchd,bthd->bhct", q, k_ctx) * scale
+    mask = np.arange(Tmax)[None, :] >= lens[:, None]          # [B, Tmax]
+    s_ctx = np.where(mask[:, None, None, :], -1e30, s_ctx)
+    s_ch = np.einsum("bchd,bshd->bhcs", q, k_ch) * scale
+    causal = np.tril(np.ones((C, C), dtype=bool))
+    s_ch = np.where(causal[None, None], s_ch, -1e30)
+    probs = _softmax(np.concatenate([s_ctx, s_ch], axis=-1))
+    out = np.einsum("bhct,bthd->bchd", probs[..., :Tmax], v_ctx)
+    return out + np.einsum("bhcs,bshd->bchd", probs[..., Tmax:], v_ch)
 
 
 def _repeat_kv(x: np.ndarray, rep: int) -> np.ndarray:
@@ -113,11 +154,35 @@ class ModelAdapter:
 
     def prefill(self, tokens: np.ndarray
                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Full-context prefill == ``prefill_ctx`` with an empty cache."""
+        L, H, D = self.n_layers, self.n_kv_heads, self.head_dim
+        empty = np.zeros((L, 0, H, D), dtype=np.float32)
+        return self.prefill_ctx(tokens, 0, empty, empty)
+
+    def prefill_ctx(self, tokens: np.ndarray, start: int,
+                    k_ctx: np.ndarray, v_ctx: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Prefill the context TAIL ``tokens`` (positions ``start`` ..
+        ``start+T``) against cached ``k_ctx/v_ctx [n_layers, start, H, D]``
+        (a prefix-cache hit's gathered blocks). Returns the last position's
+        logits plus the tail's per-layer K/V ``[n_layers, T, H, D]``."""
         raise NotImplementedError
 
     def decode(self, tokens: np.ndarray, positions: np.ndarray,
                k_ctx: np.ndarray, v_ctx: np.ndarray, lens: np.ndarray
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+    def decode_chunk(self, tokens: np.ndarray, positions: np.ndarray,
+                     k_ctx: np.ndarray, v_ctx: np.ndarray, lens: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Speculative-verify forward: score a C-token chunk per sequence
+        (``tokens [B, C]`` starting at ``positions [B]``) against the
+        gathered paged context in ONE fused pass. Returns logits
+        ``[B, C, vocab]`` and the chunk's K/V ``[B, n_layers, C, H, D]``;
+        the engine writes only the accepted prefix back to the cache. On a
+        TPU replica this (like ``decode``) is the pallas paged-attention
+        seam."""
         raise NotImplementedError
 
 
@@ -151,17 +216,19 @@ class GPT2Adapter(ModelAdapter):
     def _logits(self, x: np.ndarray) -> np.ndarray:
         return _layernorm(x, self.p["ln_f"]) @ self.p["wte"]["embedding"].T
 
-    def prefill(self, tokens: np.ndarray):
+    def prefill_ctx(self, tokens, start, k_ctx, v_ctx):
         T = len(tokens)
         p = self.p
-        x = p["wte"]["embedding"][tokens] + p["wpe"]["embedding"][:T]
+        x = p["wte"]["embedding"][tokens] \
+            + p["wpe"]["embedding"][start:start + T]
         ks, vs = [], []
         for li in range(self.n_layers):
             lp = p[f"h_{li}"]
             q, k, v = self._qkv(_layernorm(x, lp["ln_1"]), lp)
             ks.append(k)
             vs.append(v)
-            y = _causal_attend(q, k, v).reshape(T, -1)
+            y = _ctx_causal_attend(q, k_ctx[li], v_ctx[li], k, v) \
+                .reshape(T, -1)
             x = x + y @ lp["attn"]["c_proj"]["kernel"] \
                 + lp["attn"]["c_proj"]["bias"]
             x = x + self._ffn(_layernorm(x, lp["ln_2"]), lp)
@@ -178,6 +245,24 @@ class GPT2Adapter(ModelAdapter):
             v_news.append(v)
             y = _attend(q, k_ctx[:, li], v_ctx[:, li], lens, k, v)
             x = x + y.reshape(len(tokens), -1) \
+                @ lp["attn"]["c_proj"]["kernel"] + lp["attn"]["c_proj"]["bias"]
+            x = x + self._ffn(_layernorm(x, lp["ln_2"]), lp)
+        return (self._logits(x),
+                np.stack(k_news, axis=1), np.stack(v_news, axis=1))
+
+    def decode_chunk(self, tokens, positions, k_ctx, v_ctx, lens):
+        B, C = tokens.shape
+        p = self.p
+        pos = positions[:, None] + np.arange(C)[None, :]          # [B, C]
+        x = p["wte"]["embedding"][tokens] + p["wpe"]["embedding"][pos]
+        k_news, v_news = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            q, k, v = self._qkv(_layernorm(x, lp["ln_1"]), lp)
+            k_news.append(k)
+            v_news.append(v)
+            y = _chunk_attend(q, k_ctx[:, li], v_ctx[:, li], lens, k, v)
+            x = x + y.reshape(B, C, -1) \
                 @ lp["attn"]["c_proj"]["kernel"] + lp["attn"]["c_proj"]["bias"]
             x = x + self._ffn(_layernorm(x, lp["ln_2"]), lp)
         return (self._logits(x),
@@ -252,10 +337,10 @@ class LlamaAdapter(ModelAdapter):
         return _rmsnorm(x, self.p["final_norm"]["weight"],
                         self.cfg.rms_eps) @ self.p["lm_head"]["kernel"]
 
-    def prefill(self, tokens: np.ndarray):
+    def prefill_ctx(self, tokens, start, k_ctx, v_ctx):
         cfg, p = self.cfg, self.p
         T = len(tokens)
-        pos = np.arange(T)
+        pos = np.arange(start, start + T)
         rep = cfg.n_head // cfg.n_kv_head
         x = p["tok_emb"]["embedding"][tokens]
         ks, vs = [], []
@@ -267,7 +352,10 @@ class LlamaAdapter(ModelAdapter):
             v = self._proj(h, lp, "wv", cfg.n_kv_head)
             ks.append(k)
             vs.append(v)
-            y = _causal_attend(q, _repeat_kv(k, rep), _repeat_kv(v, rep))
+            y = _ctx_causal_attend(q,
+                                   _repeat_kv(k_ctx[li], rep),
+                                   _repeat_kv(v_ctx[li], rep),
+                                   _repeat_kv(k, rep), _repeat_kv(v, rep))
             x = x + y.reshape(T, -1) @ lp["attn"]["wo"]["kernel"]
             x = x + self._block_mlp(
                 _rmsnorm(x, lp["mlp_norm"]["weight"], cfg.rms_eps), lp)
@@ -296,6 +384,31 @@ class LlamaAdapter(ModelAdapter):
         return (self._logits(x),
                 np.stack(k_news, axis=1), np.stack(v_news, axis=1))
 
+    def decode_chunk(self, tokens, positions, k_ctx, v_ctx, lens):
+        cfg, p = self.cfg, self.p
+        B, C = tokens.shape
+        rep = cfg.n_head // cfg.n_kv_head
+        pos = positions[:, None] + np.arange(C)[None, :]          # [B, C]
+        x = p["tok_emb"]["embedding"][tokens]
+        k_news, v_news = [], []
+        for li in range(self.n_layers):
+            lp = p[f"h_{li}"]
+            h = _rmsnorm(x, lp["attn_norm"]["weight"], cfg.rms_eps)
+            q = self._rope(self._proj(h, lp, "wq", cfg.n_head), pos)
+            k = self._rope(self._proj(h, lp, "wk", cfg.n_kv_head), pos)
+            v = self._proj(h, lp, "wv", cfg.n_kv_head)
+            k_news.append(k)
+            v_news.append(v)
+            y = _chunk_attend(q,
+                              _repeat_kv(k_ctx[:, li], rep),
+                              _repeat_kv(v_ctx[:, li], rep),
+                              lens, _repeat_kv(k, rep), _repeat_kv(v, rep))
+            x = x + y.reshape(B, C, -1) @ lp["attn"]["wo"]["kernel"]
+            x = x + self._block_mlp(
+                _rmsnorm(x, lp["mlp_norm"]["weight"], cfg.rms_eps), lp)
+        return (self._logits(x),
+                np.stack(k_news, axis=1), np.stack(v_news, axis=1))
+
 
 # ---------------------------------------------------------------------- fake
 
@@ -304,20 +417,39 @@ class FakeAdapter(ModelAdapter):
     """Model-free adapter for scheduler/engine tests and pure-batching
     benches. Deterministic: the next token is a function of the last token
     AND the KV cache contents (each position's K stores its token id), so a
-    block-table bug or a bad gather changes the output stream."""
+    block-table bug or a bad gather changes the output stream.
+
+    ``step_cost_s`` sleeps once per adapter CALL (a fused batch is one
+    call, like one accelerator dispatch), so the spec-decode bench can
+    model a target:draft cost ratio. ``disagree_every`` perturbs the next
+    token whenever the true next token is divisible by it — used as the
+    DRAFT in speculative tests/benches for a deterministic, partial
+    acceptance rate (≈ 1 - 1/q) instead of the degenerate 0 or 1."""
 
     def __init__(self, vocab_size: int = 97, n_layers: int = 1,
                  n_kv_heads: int = 1, head_dim: int = 1,
-                 max_context: int = 4096, step_cost_s: float = 0.0):
+                 max_context: int = 4096, step_cost_s: float = 0.0,
+                 disagree_every: int = 0):
         self.vocab_size = vocab_size
         self.n_layers = n_layers
         self.n_heads = self.n_kv_heads = n_kv_heads
         self.head_dim = head_dim
         self.max_context = max_context
-        self.step_cost_s = step_cost_s  # simulated model time per step
+        self.step_cost_s = step_cost_s  # simulated model time per call
+        self.disagree_every = int(disagree_every)
+
+    def _sleep(self):
+        if self.step_cost_s:
+            import time
+            time.sleep(self.step_cost_s)
 
     def _next(self, ctx_sum: np.ndarray, tokens: np.ndarray) -> np.ndarray:
-        return (ctx_sum.astype(np.int64) + tokens * 31 + 7) % self.vocab_size
+        nxt = (np.asarray(ctx_sum).astype(np.int64)
+               + tokens * 31 + 7) % self.vocab_size
+        if self.disagree_every:
+            nxt = np.where(nxt % self.disagree_every == 0,
+                           (nxt + 1) % self.vocab_size, nxt)
+        return nxt
 
     def _logits_for(self, nxt: np.ndarray) -> np.ndarray:
         out = np.zeros(nxt.shape + (self.vocab_size,), dtype=np.float32)
@@ -331,22 +463,22 @@ class FakeAdapter(ModelAdapter):
         ).copy()
         return kv, kv.copy()
 
-    def prefill(self, tokens: np.ndarray):
-        if self.step_cost_s:
-            import time
-            time.sleep(self.step_cost_s)
+    def prefill_ctx(self, tokens, start, k_ctx, v_ctx):
+        self._sleep()
         tokens = np.asarray(tokens)
-        # same semantics as decode with cache = tokens[:-1], input = last —
-        # a preempted sequence's recompute must continue identically
-        nxt = self._next(np.float64(tokens[:-1].sum()), tokens[-1:])
+        # same semantics as decode with cache = everything-but-last, input =
+        # last (a preempted sequence's recompute must continue identically);
+        # the cached prefix is read back THROUGH the gathered blocks so a
+        # prefix-cache or COW bug changes the output
+        ctx_sum = np.float64(k_ctx[0, :, 0, 0].sum()) \
+            + np.float64(tokens[:-1].sum())
+        nxt = self._next(ctx_sum, tokens[-1:])
         k, v = self._kv(tokens)  # [T, L, H, D] -> [L, T, H, D]
         return (self._logits_for(nxt)[0],
                 np.moveaxis(k, 0, 1), np.moveaxis(v, 0, 1))
 
     def decode(self, tokens, positions, k_ctx, v_ctx, lens):
-        if self.step_cost_s:
-            import time
-            time.sleep(self.step_cost_s)
+        self._sleep()
         # context read back THROUGH the gathered cache: [B, L, Tmax, H, D]
         # (masked by lens — padding slots may carry stale block data)
         valid = np.arange(k_ctx.shape[2])[None, :] < lens[:, None]
@@ -354,6 +486,18 @@ class FakeAdapter(ModelAdapter):
         nxt = self._next(ctx_sum, np.asarray(tokens))
         k, v = self._kv(np.asarray(tokens))  # [B, L, H, D]
         return self._logits_for(nxt), k, v
+
+    def decode_chunk(self, tokens, positions, k_ctx, v_ctx, lens):
+        self._sleep()
+        tokens = np.asarray(tokens)                               # [B, C]
+        valid = np.arange(k_ctx.shape[2])[None, :] < lens[:, None]
+        base = (k_ctx[:, 0, :, 0, 0] * valid).sum(axis=1)         # [B]
+        # chunk position c additionally sees chunk tokens [0, c)
+        csum = np.cumsum(tokens, axis=1) - tokens                 # exclusive
+        nxt = self._next(base[:, None] + csum, tokens)            # [B, C]
+        k, v = self._kv(tokens)               # [B, C, L, H, D] -> B,L,C,H,D
+        return (self._logits_for(nxt),
+                np.moveaxis(k, 1, 2), np.moveaxis(v, 1, 2))
 
 
 # ----------------------------------------------------------------- model zoo

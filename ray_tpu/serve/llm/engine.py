@@ -7,6 +7,22 @@ for everything running, commit + deliver tokens). It is thread-safe behind
 one coarse lock and has no asyncio/ray dependencies — the bench and the
 unit tests drive it directly.
 
+Two serving optimizations ride the same step loop, both byte-equal to
+plain greedy decoding:
+
+  - **prefix caching** (``RTPU_llm_prefix_cache``): admission maps the
+    longest indexed prompt prefix read-only into the new sequence's block
+    table (see ``kv_cache.py``) and the engine prefills only the un-hit
+    tail via the adapter's ``prefill_ctx``;
+  - **speculative decoding** (``RTPU_llm_draft_model`` +
+    ``RTPU_llm_spec_k``): a tiny draft model proposes ``k`` tokens through
+    its own paged cache, the target verifies all of them in ONE fused
+    ``decode_chunk`` forward, and the longest agreeing run (+1 bonus
+    token) commits; the draft cache rolls back with a refcount-aware
+    ``truncate``. Greedy acceptance means the stream is exactly what the
+    target alone would have produced. Only temperature-0 sequences
+    speculate; sampled ones take the plain fused decode.
+
 ``LLMReplica`` is the serve-facing wrapper: an async step loop pumps the
 engine off the actor's event loop (model math runs in the default
 executor so queue probes and pulls stay responsive), requests arrive as
@@ -22,16 +38,22 @@ contract, see ``util/metrics.py``):
   ray_tpu_llm_kv_utilization      gauge, 0-1 fraction of KV blocks in use
   ray_tpu_llm_batch_size          gauge, sequences in the last step
   ray_tpu_llm_preemptions_total   counter
+  ray_tpu_llm_prefix_hit_rate     gauge, cumulative fraction of looked-up
+                                  prompt tokens served from the prefix
+                                  cache
+  ray_tpu_llm_spec_acceptance     gauge, cumulative fraction of proposed
+                                  draft tokens the target accepted
 
 and the flight recorder gets ``llm.admit`` / ``llm.preempt`` /
-``llm.finish`` events (PR 3 contract: cheap tuples, no formatting until
-dump).
+``llm.finish`` / ``llm.prefix_hit`` / ``llm.spec_verify`` events (PR 3
+contract: cheap tuples, no formatting until dump).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Union
 
@@ -40,7 +62,7 @@ import numpy as np
 from ray_tpu._private.config import RTPU_CONFIG
 from ray_tpu.serve.llm import scheduler as sched_mod
 from ray_tpu.serve.llm.adapters import ModelAdapter, build_adapter
-from ray_tpu.serve.llm.kv_cache import PagedKVCache
+from ray_tpu.serve.llm.kv_cache import KVCacheExhausted, PagedKVCache
 from ray_tpu.serve.llm.scheduler import Scheduler, Sequence
 
 
@@ -85,12 +107,15 @@ class SamplingParams:
 class _SeqSampling:
     """Per-sequence sampling state riding on Sequence.sampling."""
 
-    __slots__ = ("params", "rng")
+    __slots__ = ("params", "rng", "spec")
 
     def __init__(self, params: SamplingParams):
         self.params = params
         self.rng = (np.random.default_rng(params.seed)
                     if params.temperature > 0 else None)
+        # set at prefill time: the draft cache admitted this sequence, so
+        # it takes the speculative decode path (greedy sequences only)
+        self.spec = False
 
 
 _llm_metrics = None
@@ -115,6 +140,14 @@ def _metrics():
             "preempt": Counter(
                 "ray_tpu_llm_preemptions_total",
                 "sequences requeued on KV exhaustion", tag_keys=tags),
+            "prefix_hit": Gauge(
+                "ray_tpu_llm_prefix_hit_rate",
+                "fraction of prompt tokens served from the prefix cache",
+                tag_keys=tags),
+            "spec_accept": Gauge(
+                "ray_tpu_llm_spec_acceptance",
+                "fraction of proposed draft tokens the target accepted",
+                tag_keys=tags),
         }
     return _llm_metrics
 
@@ -142,28 +175,58 @@ class LLMEngine:
         max_batch: Optional[int] = None,
         max_waiting: Optional[int] = None,
         name: str = "llm",
+        prefix_cache: Optional[bool] = None,
+        draft_adapter: Optional[ModelAdapter] = None,
+        spec_k: Optional[int] = None,
     ):
         self.adapter = adapter
         block_size = int(block_size or RTPU_CONFIG.llm_block_size)
         num_blocks = int(num_blocks or RTPU_CONFIG.llm_num_blocks)
+        self.prefix_cache_enabled = bool(
+            RTPU_CONFIG.llm_prefix_cache if prefix_cache is None
+            else prefix_cache)
         self.cache = PagedKVCache(
             num_blocks=num_blocks,
             block_size=block_size,
             n_layers=adapter.n_layers,
             n_kv_heads=adapter.n_kv_heads,
             head_dim=adapter.head_dim,
+            enable_prefix_cache=self.prefix_cache_enabled,
         )
         self.scheduler = Scheduler(
             self.cache,
             max_batch_size=int(max_batch or RTPU_CONFIG.llm_max_batch),
             max_waiting=int(max_waiting or RTPU_CONFIG.llm_max_waiting),
         )
+        self.spec_k = int(RTPU_CONFIG.llm_spec_k if spec_k is None
+                          else spec_k)
+        self.draft_adapter = draft_adapter if self.spec_k > 0 else None
+        self.draft_cache: Optional[PagedKVCache] = None
+        if self.draft_adapter is not None:
+            if self.draft_adapter.vocab_size != adapter.vocab_size:
+                raise ValueError(
+                    f"draft vocab {self.draft_adapter.vocab_size} != "
+                    f"target vocab {adapter.vocab_size}")
+            self.draft_cache = PagedKVCache(
+                num_blocks=num_blocks,
+                block_size=block_size,
+                n_layers=self.draft_adapter.n_layers,
+                n_kv_heads=self.draft_adapter.n_kv_heads,
+                head_dim=self.draft_adapter.head_dim,
+                enable_prefix_cache=self.prefix_cache_enabled,
+            )
         self._out: Dict[str, _OutBuffer] = {}
+        # finish reasons of recently drained sequences: a re-pull of a
+        # drained id gets its true terminal marker, not "unknown"
+        self._done_reasons: "OrderedDict[str, str]" = OrderedDict()
         self._lock = threading.RLock()
         self._tags = {"deployment": name, "replica": ""}
         self._tokens_per_s = 0.0  # EMA over steps
         self.steps_total = 0
         self.tokens_total = 0
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_rounds_total = 0
 
     def set_identity(self, deployment: str, replica: str = ""):
         self._tags = {"deployment": deployment, "replica": replica}
@@ -216,14 +279,27 @@ class LLMEngine:
     def pull(self, seq_id: str, max_tokens: int = 0):
         """Drain up to ``max_tokens`` (0 = all) buffered tokens. Returns
         ``(tokens, done, finish_reason)``; ``done`` only once the buffer is
-        empty AND the sequence finished. KeyError for unknown ids."""
+        empty AND the sequence finished.
+
+        An unknown or already-finished-and-drained id returns a terminal
+        marker (``([], True, reason)``) immediately — the replica's
+        long-poll keys its wait on ``done``, so raising (or returning a
+        not-done empty read) here would sleep a retried client out of its
+        full ``RTPU_llm_pull_wait_s`` window for a sequence that can never
+        produce another token. Recently drained ids keep their true finish
+        reason in a bounded ring; everything older reports ``"unknown"``."""
         with self._lock:
-            buf = self._out[seq_id]
+            buf = self._out.get(seq_id)
+            if buf is None:
+                return [], True, self._done_reasons.get(seq_id, "unknown")
             n = len(buf.tokens) if max_tokens <= 0 else int(max_tokens)
             out, buf.tokens = buf.tokens[:n], buf.tokens[n:]
             done = buf.done and not buf.tokens
             if done:
                 self._out.pop(seq_id, None)
+                self._done_reasons[seq_id] = buf.finish_reason or "unknown"
+                while len(self._done_reasons) > 1024:
+                    self._done_reasons.popitem(last=False)
             return out, done, buf.finish_reason
 
     # --------------------------------------------------------------- the step
@@ -242,6 +318,174 @@ class LLMEngine:
         probs /= probs.sum()
         return int(sp.rng.choice(len(probs), p=probs))
 
+    def _free_draft(self, seq_id: str) -> None:
+        if self.draft_cache is not None:
+            self.draft_cache.free(seq_id)
+
+    def _prefill_seq(self, seq: Sequence) -> np.ndarray:
+        """Run the (possibly tail-only) prefill for a just-admitted
+        sequence, write + index its KV, and mirror it into the draft cache
+        when speculating. Returns the last position's logits. Raises
+        KVCacheExhausted if the target-side write cannot complete — the
+        caller frees the partial hold and requeues."""
+        from ray_tpu._private import flight_recorder as _fr
+
+        ctx = seq.context_tokens()
+        cached = min(seq.cached_len, len(ctx) - 1)
+        if cached:
+            k_ctx, v_ctx = self.cache.gather(seq.seq_id)
+            logits, k, v = self.adapter.prefill_ctx(
+                np.asarray(ctx[cached:], dtype=np.int64), cached,
+                k_ctx, v_ctx)
+            _fr.record("llm.prefix_hit", b"",
+                       f"{seq.seq_id} hit={cached}/{len(ctx)}")
+        else:
+            logits, k, v = self.adapter.prefill(
+                np.asarray(ctx, dtype=np.int64))
+        self.cache.write_prefill(seq.seq_id, k, v)
+        self.cache.register_prefix(seq.seq_id, ctx)
+        sp: Optional[_SeqSampling] = seq.sampling
+        if (self.draft_cache is not None and sp is not None
+                and sp.params.temperature <= 0):
+            sp.spec = self._draft_prefill(seq.seq_id, ctx)
+        return logits
+
+    def _draft_prefill(self, seq_id: str, ctx: List[int]) -> bool:
+        """Mirror the context into the draft cache (prefix-aware too).
+        Failure is not fatal — the sequence just decodes without
+        speculation."""
+        dc, da = self.draft_cache, self.draft_adapter
+        dc.free(seq_id)  # defensive: re-admission after an interrupted try
+        served = dc.allocate_cached(seq_id, ctx, extra=self.spec_k + 1)
+        if served is None:
+            return False
+        try:
+            if served:
+                k_ctx, v_ctx = dc.gather(seq_id)
+                _, k, v = da.prefill_ctx(
+                    np.asarray(ctx[served:], dtype=np.int64), served,
+                    k_ctx, v_ctx)
+            else:
+                _, k, v = da.prefill(np.asarray(ctx, dtype=np.int64))
+            dc.write_prefill(seq_id, k, v)
+            dc.register_prefix(seq_id, ctx)
+        except KVCacheExhausted:
+            dc.free(seq_id)
+            return False
+        return True
+
+    def _draft_extend(self, seqs: List[Sequence], n: int) -> bool:
+        for s in seqs:
+            if not self.draft_cache.extend(s.seq_id, n):
+                return False
+        return True
+
+    def _spec_decode(self, seqs: List[Sequence]
+                     ) -> Optional[Dict[str, List[int]]]:
+        """Speculative decode for one step's greedy sequences: the draft
+        proposes up to ``spec_k`` tokens (fused over the batch through its
+        own paged cache), the target scores the whole chunk in ONE fused
+        ``decode_chunk`` forward, and each sequence keeps its longest
+        agreeing run plus the bonus token — exactly the tokens sequential
+        greedy decoding would have produced. Rejected draft positions roll
+        the draft cache back via the refcount-aware ``truncate``. Returns
+        None when the draft pool cannot even start a round (callers fall
+        back to the plain fused decode this step)."""
+        from ray_tpu._private import flight_recorder as _fr
+
+        da, dc = self.draft_adapter, self.draft_cache
+        ids = [s.seq_id for s in seqs]
+        # 1. catch-up: the draft cache must cover exactly the positions the
+        #    target cache holds (it runs one token behind after a fully
+        #    accepted round; further behind is impossible by construction)
+        while True:
+            lag = [s for s in seqs
+                   if dc.seq_lens[s.seq_id] < self.cache.seq_lens[s.seq_id]]
+            if not lag:
+                break
+            if not self._draft_extend(lag, 1):
+                return None
+            toks = np.asarray(
+                [s.context_tokens()[dc.seq_lens[s.seq_id]] for s in lag],
+                dtype=np.int64)
+            lag_ids = [s.seq_id for s in lag]
+            pos = np.asarray([dc.seq_lens[i] for i in lag_ids],
+                             dtype=np.int64)
+            k_ctx, v_ctx, lens = dc.gather_batch(lag_ids)
+            _, k_new, v_new = da.decode(toks, pos, k_ctx, v_ctx, lens)
+            for i, s in enumerate(lag):
+                dc.append(s.seq_id, k_new[i], v_new[i])
+
+        # 2. propose: k fused draft decode steps
+        B = len(seqs)
+        last = np.asarray([s.tokens[-1] for s in seqs], dtype=np.int64)
+        drafts = np.zeros((B, self.spec_k), dtype=np.int64)
+        k_eff = 0
+        cur = last
+        for j in range(self.spec_k):
+            if not self._draft_extend(seqs, 1):
+                break
+            pos = np.asarray([dc.seq_lens[i] for i in ids], dtype=np.int64)
+            k_ctx, v_ctx, lens = dc.gather_batch(ids)
+            logits, k_new, v_new = da.decode(cur, pos, k_ctx, v_ctx, lens)
+            for i, s in enumerate(seqs):
+                dc.append(s.seq_id, k_new[i], v_new[i])
+            cur = np.argmax(logits, axis=-1).astype(np.int64)
+            drafts[:, j] = cur
+            k_eff = j + 1
+        if k_eff == 0:
+            return None
+
+        # 3. verify: one fused target forward over [last, d0..d_{k-1}]
+        chunk = np.concatenate([last[:, None], drafts[:, :k_eff]], axis=1)
+        pos = np.asarray([self.cache.seq_lens[i] for i in ids],
+                         dtype=np.int64)
+        k_ctx, v_ctx, lens = self.cache.gather_batch(ids)
+        logits, k_new, v_new = self.adapter.decode_chunk(
+            chunk, pos, k_ctx, v_ctx, lens)
+        greedy = np.argmax(logits, axis=-1)                    # [B, k_eff+1]
+
+        bs = self.cache.block_size
+        sampled: Dict[str, List[int]] = {}
+        accepted_round = 0
+        for i, s in enumerate(seqs):
+            agree = 0
+            while (agree < k_eff
+                   and int(drafts[i, agree]) == int(greedy[i, agree])):
+                agree += 1
+            n_emit = agree + 1
+            # clip to the sequence's budget, to EOS, and to what the pool
+            # can still hold this step (>= 1 slot is pre-reserved by the
+            # scheduler, so plain-decode progress is always possible)
+            n_emit = min(n_emit, max(1, s.max_tokens - len(s.tokens)))
+            emitted = [int(greedy[i, c]) for c in range(n_emit)]
+            if s.eos_id is not None and s.eos_id in emitted:
+                n_emit = emitted.index(s.eos_id) + 1
+                emitted = emitted[:n_emit]
+            sid = s.seq_id
+            slack = (len(self.cache.block_tables[sid]) * bs
+                     - self.cache.seq_lens[sid]
+                     + self.cache.num_free_blocks * bs)
+            if n_emit > slack:
+                n_emit = max(1, slack)
+                emitted = emitted[:n_emit]
+            self.cache.write_prefill(
+                sid, k_new[i, :, :n_emit], v_new[i, :, :n_emit])
+            # roll the draft back to the accepted length; after a fully
+            # accepted chunk it is one token SHORT instead (caught up at
+            # the start of the next round)
+            new_kv_len = int(pos[i]) + n_emit
+            if dc.seq_lens[sid] > new_kv_len:
+                dc.truncate(sid, new_kv_len)
+            sampled[sid] = emitted
+            accepted_round += n_emit - 1
+        self.spec_rounds_total += 1
+        self.spec_proposed_total += k_eff * B
+        self.spec_accepted_total += accepted_round
+        _fr.record("llm.spec_verify", b"",
+                   f"batch={B} k={k_eff} accepted={accepted_round}")
+        return sampled
+
     def step(self) -> Dict[str, Any]:
         """One engine iteration; returns step stats (also published as
         gauges). A no-op returning ``{"batch_size": 0}`` when idle."""
@@ -252,48 +496,81 @@ class LLMEngine:
             plan = self.scheduler.schedule()
             for seq in plan.reaped:
                 self._finish_buffer(seq)
+                self._free_draft(seq.seq_id)
             for seq in plan.preempted:
+                self._free_draft(seq.seq_id)
                 _fr.record("llm.preempt", b"",
                            f"{seq.seq_id} ctx={seq.total_len}")
             if plan.batch_size == 0:
                 self._publish(0, 0, 0.0)
                 return {"batch_size": 0, "tokens": 0}
 
-            sampled: Dict[str, int] = {}
+            sampled: Dict[str, Union[int, List[int]]] = {}
             for seq in plan.prefills:
-                ctx = np.asarray(seq.context_tokens(), dtype=np.int64)
-                logits, k, v = self.adapter.prefill(ctx)
-                self.cache.write_prefill(seq.seq_id, k, v)
+                try:
+                    logits = self._prefill_seq(seq)
+                except KVCacheExhausted:
+                    # admission interrupted mid-prefill (e.g. a
+                    # copy-on-write with an empty pool): free the partial
+                    # hold FIRST — requeueing with blocks still pinned
+                    # would leak shared refcounts — then retry next step
+                    self.cache.free(seq.seq_id)
+                    self._free_draft(seq.seq_id)
+                    self.scheduler.requeue(seq)
+                    _fr.record("llm.preempt", b"",
+                               f"{seq.seq_id} ctx={seq.total_len} admit")
+                    continue
                 sampled[seq.seq_id] = self._sample(seq, logits)
                 _fr.record("llm.admit", b"",
-                           f"{seq.seq_id} prompt={len(ctx)} "
+                           f"{seq.seq_id} prompt={seq.total_len} "
+                           f"hit={seq.cached_len} "
                            f"kv={self.cache.utilization():.2f}")
             if plan.decodes:
-                ids = [s.seq_id for s in plan.decodes]
-                toks = np.asarray([s.tokens[-1] for s in plan.decodes],
-                                  dtype=np.int64)
-                pos = np.asarray([self.cache.seq_lens[i] for i in ids],
-                                 dtype=np.int64)
-                k_ctx, v_ctx, lens = self.cache.gather_batch(ids)
-                logits, k_new, v_new = self.adapter.decode(
-                    toks, pos, k_ctx, v_ctx, lens)
-                for i, seq in enumerate(plan.decodes):
-                    self.cache.append(seq.seq_id, k_new[i], v_new[i])
-                    sampled[seq.seq_id] = self._sample(seq, logits[i])
+                spec_seqs = [
+                    s for s in plan.decodes
+                    if getattr(s.sampling, "spec", False)
+                ] if self.draft_cache is not None else []
+                spec_ids = {s.seq_id for s in spec_seqs}
+                plain = [s for s in plan.decodes
+                         if s.seq_id not in spec_ids]
+                if spec_seqs:
+                    out = self._spec_decode(spec_seqs)
+                    if out is None:
+                        plain = plain + spec_seqs
+                    else:
+                        sampled.update(out)
+                if plain:
+                    ids = [s.seq_id for s in plain]
+                    toks = np.asarray([s.tokens[-1] for s in plain],
+                                      dtype=np.int64)
+                    pos = np.asarray([self.cache.seq_lens[i] for i in ids],
+                                     dtype=np.int64)
+                    k_ctx, v_ctx, lens = self.cache.gather_batch(ids)
+                    logits, k_new, v_new = self.adapter.decode(
+                        toks, pos, k_ctx, v_ctx, lens)
+                    for i, seq in enumerate(plain):
+                        self.cache.append(seq.seq_id, k_new[i], v_new[i])
+                        sampled[seq.seq_id] = self._sample(seq, logits[i])
 
+            by_id = {s.seq_id: s for s in plan.prefills + plan.decodes}
+            before = {sid: len(by_id[sid].tokens) for sid in sampled}
             finished = self.scheduler.commit(sampled)
-            for seq_id, tok in sampled.items():
-                buf = self._out.get(seq_id)
+            n_tokens = 0
+            for sid in sampled:
+                seq = by_id[sid]
+                committed = seq.tokens[before[sid]:]
+                n_tokens += len(committed)
+                buf = self._out.get(sid)
                 if buf is not None and not buf.done:
-                    buf.tokens.append(tok)
+                    buf.tokens.extend(committed)
             for seq in finished:
                 self._finish_buffer(seq)
+                self._free_draft(seq.seq_id)
                 _fr.record("llm.finish", b"",
                            f"{seq.seq_id} reason={seq.finish_reason} "
                            f"tokens={len(seq.tokens)}")
 
             dt = max(time.perf_counter() - t0, 1e-9)
-            n_tokens = len(sampled)
             self.steps_total += 1
             self.tokens_total += n_tokens
             inst = n_tokens / dt
@@ -317,6 +594,13 @@ class LLMEngine:
             buf.done = True
             buf.finish_reason = seq.finish_reason
 
+    def spec_acceptance(self) -> float:
+        """Cumulative fraction of proposed draft tokens the target
+        accepted (the ``ray_tpu_llm_spec_acceptance`` gauge)."""
+        if not self.spec_proposed_total:
+            return 0.0
+        return self.spec_accepted_total / self.spec_proposed_total
+
     def _publish(self, batch: int, preempted: int, dt: float):
         try:
             m = _metrics()
@@ -325,6 +609,11 @@ class LLMEngine:
             m["batch"].set(batch, tags=self._tags)
             if preempted:
                 m["preempt"].inc(preempted, tags=self._tags)
+            if self.prefix_cache_enabled:
+                m["prefix_hit"].set(self.cache.hit_rate(), tags=self._tags)
+            if self.draft_cache is not None:
+                m["spec_accept"].set(self.spec_acceptance(),
+                                     tags=self._tags)
         except Exception:
             pass
 
@@ -341,7 +630,7 @@ class LLMEngine:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "waiting": len(self.scheduler.waiting),
                 "running": len(self.scheduler.running),
                 "kv_utilization": round(self.cache.utilization(), 4),
@@ -352,6 +641,18 @@ class LLMEngine:
                 "preemptions_total": self.scheduler.preemptions_total,
                 "finished_total": self.scheduler.finished_total,
             }
+            if self.prefix_cache_enabled:
+                out.update({
+                    "prefix_hit_rate": round(self.cache.hit_rate(), 4),
+                    "kv_cached_blocks": self.cache.num_cached_blocks,
+                    "cow_copies": self.cache.cow_copies,
+                })
+            if self.draft_cache is not None:
+                out.update({
+                    "spec_acceptance": round(self.spec_acceptance(), 4),
+                    "spec_rounds_total": self.spec_rounds_total,
+                })
+            return out
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Drive the engine until no work remains (bench/test helper);
@@ -392,17 +693,30 @@ class LLMReplica:
         block_size: Optional[int] = None,
         max_batch: Optional[int] = None,
         max_waiting: Optional[int] = None,
+        prefix_cache: Optional[bool] = None,
+        draft_model: Optional[str] = None,
+        draft_model_config: Optional[dict] = None,
+        spec_k: Optional[int] = None,
         seed: int = 0,
     ):
         adapter = build_adapter(model, model_config, seed=seed)
+        if draft_model is None:
+            draft_model = str(RTPU_CONFIG.llm_draft_model or "")
+        draft_adapter = (build_adapter(draft_model, draft_model_config,
+                                       seed=seed)
+                         if draft_model else None)
         self.engine = LLMEngine(
             adapter,
             num_blocks=num_blocks,
             block_size=block_size,
             max_batch=max_batch,
             max_waiting=max_waiting,
+            prefix_cache=prefix_cache,
+            draft_adapter=draft_adapter,
+            spec_k=spec_k,
         )
         self.model = model
+        self.draft_model = draft_model or None
         self._loop_task = None
         self._tick = None          # asyncio.Event, re-armed every step
         self._wake = None          # set on submit while the loop is idle
@@ -483,13 +797,11 @@ class LLMReplica:
         while True:
             # grab the CURRENT tick event before reading the buffer: a step
             # landing between the read and the wait sets this very event,
-            # so the wait below returns immediately instead of timing out
+            # so the wait below returns immediately instead of timing out.
+            # An unknown or already-drained id comes back from the engine
+            # as a terminal marker (done=True), never a long-poll sleep.
             ev = self._tick
-            try:
-                toks, done, reason = self.engine.pull(request_id, max_tokens)
-            except KeyError:
-                return {"tokens": b"", "done": True,
-                        "finish_reason": "unknown"}
+            toks, done, reason = self.engine.pull(request_id, max_tokens)
             if toks or done or _time.monotonic() >= deadline:
                 return {
                     "tokens": np.asarray(toks, dtype=np.int32).tobytes(),

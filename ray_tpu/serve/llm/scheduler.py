@@ -9,7 +9,9 @@ scheduler
      step will produce — preempting the youngest sequence back to the
      waiting queue (recompute-on-resume) when the cache is out of blocks,
   3. admits waiting prompts into spare batch slots while their prompt fits
-     in the cache (these run as prefills this step),
+     in the cache (these run as prefills this step) — admission is
+     prefix-aware: the longest cached prefix is mapped read-only into the
+     block table and only the tail is charged to the pool (and prefilled),
 
 and returns a :class:`StepPlan`. The engine executes the plan against the
 model adapter and calls :meth:`Scheduler.commit` with the sampled tokens;
@@ -25,7 +27,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from ray_tpu.serve.llm.kv_cache import PagedKVCache
 
@@ -56,6 +58,9 @@ class Sequence:
     preemptions: int = 0
     cancelled: bool = False
     finish_reason: Optional[str] = None
+    # context tokens whose KV the prefix cache already held at admission —
+    # the engine prefills only context_tokens()[cached_len:]
+    cached_len: int = 0
     # opaque slot for the engine (sampling state rides along)
     sampling: Optional[object] = None
 
@@ -163,14 +168,19 @@ class Scheduler:
         self.running = survivors
 
         # 3. admit prefills into spare slots while their context fits,
-        #    +1 so the first decode step cannot immediately preempt them
+        #    +1 so the first decode step cannot immediately preempt them.
+        #    allocate_cached maps the longest indexed prefix read-only into
+        #    the block table and charges the pool only for the tail — the
+        #    engine then prefills context_tokens()[cached_len:].
         plan.decodes = list(self.running)
         while (self.waiting
                and plan.batch_size < self.max_batch_size):
             seq = self.waiting[0]
-            need = len(seq.context_tokens()) + 1
-            if not self.cache.allocate(seq.seq_id, need):
+            served = self.cache.allocate_cached(
+                seq.seq_id, seq.context_tokens(), extra=1)
+            if served is None:
                 break  # head-of-line blocks: FIFO fairness over packing
+            seq.cached_len = served
             self.waiting.pop(0)
             seq.state = RUNNING
             self.running.append(seq)
@@ -182,27 +192,50 @@ class Scheduler:
         waiting with the generated tokens folded into the context."""
         self.cache.free(seq.seq_id)
         seq.state = WAITING
+        seq.cached_len = 0
         seq.preemptions += 1
         self.preemptions_total += 1
         self.waiting.insert(0, seq)
 
-    def commit(self, tokens: Dict[str, int]) -> List[Sequence]:
-        """Apply one step's sampled tokens (``seq_id -> token``) and the
+    def requeue(self, seq: Sequence) -> None:
+        """Return a just-admitted sequence to the head of waiting after its
+        prefill was interrupted (KVCacheExhausted mid-admission). The
+        engine has already freed the partial block hold — requeueing with
+        it still allocated would leak pinned shared blocks."""
+        if seq.seq_id in self.cache.block_tables:
+            raise AssertionError(
+                f"requeue({seq.seq_id!r}) with blocks still allocated")
+        if seq in self.running:
+            self.running.remove(seq)
+        seq.state = WAITING
+        seq.cached_len = 0
+        self.waiting.insert(0, seq)
+
+    def commit(self, tokens: Dict[str, Union[int, List[int]]]
+               ) -> List[Sequence]:
+        """Apply one step's sampled tokens (``seq_id -> token`` or, from a
+        speculative-decode step, ``seq_id -> [tokens...]``) and the
         termination rules; returns the sequences that finished this step
-        (their cache blocks already freed)."""
+        (their cache blocks already freed). A terminal token (EOS /
+        max_tokens / cancel) stops the list early — accepted-but-post-EOS
+        speculation is discarded, keeping the stream byte-equal to
+        non-speculative decoding."""
         finished: List[Sequence] = []
-        for seq_id, tok in tokens.items():
+        for seq_id, toks in tokens.items():
             seq = self._by_id.get(seq_id)
             if seq is None or seq.state != RUNNING:
                 continue
-            seq.tokens.append(int(tok))
             reason = None
-            if seq.cancelled:
-                reason = FINISH_CANCELLED
-            elif seq.eos_id is not None and int(tok) == seq.eos_id:
-                reason = FINISH_EOS
-            elif len(seq.tokens) >= seq.max_tokens:
-                reason = FINISH_LENGTH
+            for tok in ([toks] if isinstance(toks, int) else toks):
+                seq.tokens.append(int(tok))
+                if seq.cancelled:
+                    reason = FINISH_CANCELLED
+                elif seq.eos_id is not None and int(tok) == seq.eos_id:
+                    reason = FINISH_EOS
+                elif len(seq.tokens) >= seq.max_tokens:
+                    reason = FINISH_LENGTH
+                if reason is not None:
+                    break
             if reason is not None:
                 self.running.remove(seq)
                 self.cache.free(seq.seq_id)

@@ -43,6 +43,15 @@ change (add new series instead). The stable set:
                                        engine step
     ray_tpu_llm_preemptions_total      counter, sequences requeued on KV
                                        exhaustion
+    ray_tpu_llm_prefix_hit_rate        gauge, 0-1 cumulative fraction of
+                                       looked-up prompt tokens served
+                                       from the shared-prefix KV index
+                                       (only published with
+                                       RTPU_llm_prefix_cache on)
+    ray_tpu_llm_spec_acceptance        gauge, 0-1 cumulative fraction of
+                                       proposed draft tokens the target
+                                       model accepted (only published
+                                       when a draft model is loaded)
 
   profiling plane (_private/watchdog.py, labels: trigger — the incident
   kind or trigger that caused the capture: slow_step, stuck_task, ...)

@@ -1,0 +1,432 @@
+"""serve.llm prefix caching + speculative decoding.
+
+Layers under test: the refcounted prefix-sharing allocator (chained-hash
+index, copy-on-write, cached-free LRU eviction, refcount-aware
+free/truncate, the check_integrity leak sweep), the engine's prefix-hit
+tail prefill and draft-verify speculative decode (both byte-equal to the
+cold greedy baseline on fake AND real-model adapters), the
+COW/preemption interaction, interrupted-admission accounting, and the
+pull terminal-marker fast path.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.llm.adapters import FakeAdapter, build_adapter
+from ray_tpu.serve.llm.engine import LLMEngine, LLMReplica, SamplingParams
+from ray_tpu.serve.llm.kv_cache import KVCacheExhausted, PagedKVCache
+
+
+def _cache(num_blocks=16, block_size=4, n_layers=1, heads=1, dim=2,
+           prefix=True):
+    return PagedKVCache(num_blocks=num_blocks, block_size=block_size,
+                        n_layers=n_layers, n_kv_heads=heads, head_dim=dim,
+                        enable_prefix_cache=prefix)
+
+
+def _fill(c, sid, tokens):
+    """allocate_cached + write the un-hit tail (token id t -> KV value t),
+    mirroring the engine's admit path."""
+    served = c.allocate_cached(sid, tokens, extra=1)
+    assert served is not None
+    tail = np.asarray(tokens[served:], np.float32)
+    arr = np.broadcast_to(
+        tail[None, :, None, None],
+        (c.n_layers, len(tail), c.n_kv_heads, c.head_dim)).copy()
+    c.write_prefill(sid, arr, arr)
+    c.register_prefix(sid, tokens)
+    return served
+
+
+def _drain_outputs(eng, rids):
+    eng.run_until_drained()
+    out = []
+    for r in rids:
+        toks, done, reason = eng.pull(r)
+        assert done
+        out.append((toks, reason))
+    return out
+
+
+# ----------------------------------------------------- allocator: refcounts
+
+
+def test_prefix_share_and_survivor_outlives_originator():
+    c = _cache(num_blocks=16, block_size=4)
+    toks = list(range(10))                  # 2 full blocks + partial
+    assert _fill(c, "a", toks) == 0         # cold
+    assert _fill(c, "b", toks) == 8         # hits both full blocks
+    ta, tb = c.block_tables["a"], c.block_tables["b"]
+    assert ta[:2] == tb[:2] and ta[2] != tb[2]
+    assert c.ref_counts[ta[0]] == 2
+    # the survivor's mapping outlives the originator
+    c.free("a")
+    assert c.ref_counts[tb[0]] == 1
+    gk, _ = c.gather("b")
+    np.testing.assert_array_equal(gk[0, :, 0, 0], np.asarray(toks, np.float32))
+    # last reference drops -> indexed blocks park in cached-free, still hit
+    c.free("b")
+    assert c.num_used_blocks == 0 and c.num_cached_blocks == 2
+    assert _fill(c, "d", toks) == 8         # cache survives with no owner
+    c.free("d")
+    c.assert_no_leaks()
+
+
+def test_prefix_chain_hash_needs_whole_prefix():
+    c = _cache(num_blocks=32, block_size=2)
+    _fill(c, "a", [1, 2, 3, 4, 5])
+    # same second chunk, different first chunk: chained hash must miss
+    assert _fill(c, "b", [9, 9, 3, 4, 5]) == 0
+    # true shared prefix, diverging tail: only the common chunks hit
+    assert _fill(c, "d", [1, 2, 3, 4, 8, 8, 8]) == 4
+    for s in ("a", "b", "d"):
+        c.free(s)
+    c.assert_no_leaks()
+
+
+def test_cow_on_non_aligned_match_keeps_original_immutable():
+    c = _cache(num_blocks=16, block_size=4)
+    toks = [3, 1, 4, 1, 5, 9, 2, 6]          # exactly 2 full blocks
+    _fill(c, "a", toks)
+    # the cap (match <= len-1) maps block 1 shared but re-prefills its last
+    # position -> the write must copy, not mutate the indexed block
+    served = _fill(c, "b", toks)
+    assert served == 7
+    assert c.cow_copies == 1
+    assert c.block_tables["a"][1] != c.block_tables["b"][1]
+    ga, _ = c.gather("a")
+    gb, _ = c.gather("b")
+    np.testing.assert_array_equal(ga[0, :, 0, 0], gb[0, :, 0, 0])
+    c.free("a"), c.free("b")
+    c.assert_no_leaks()
+
+
+def test_truncate_respects_refcounts():
+    c = _cache(num_blocks=16, block_size=2)
+    toks = [1, 2, 3, 4, 5]
+    _fill(c, "a", toks)
+    _fill(c, "b", toks)                      # shares the 2 full blocks
+    used = c.num_used_blocks
+    c.truncate("b", 3)                       # mid-way into shared block 1
+    assert c.seq_lens["b"] == 3 and len(c.block_tables["b"]) == 2
+    # a's mapping is untouched; only b's exclusive tail block went back
+    assert c.num_used_blocks < used
+    assert c.ref_counts[c.block_tables["a"][1]] == 2
+    ga, _ = c.gather("a")
+    np.testing.assert_array_equal(ga[0, :, 0, 0], np.asarray(toks, np.float32))
+    # appending after the rollback copy-on-writes the still-shared block
+    one = np.ones((1, 1, 2), np.float32)
+    assert c.extend("b", 1)
+    c.append("b", one, one)
+    assert c.cow_copies == 1
+    ga, _ = c.gather("a")                    # originator sees no mutation
+    np.testing.assert_array_equal(ga[0, :, 0, 0], np.asarray(toks, np.float32))
+    with pytest.raises(ValueError):
+        c.truncate("b", 99)
+    c.free("a"), c.free("b")
+    c.assert_no_leaks()
+
+
+def test_cached_free_lru_eviction_under_pressure():
+    c = _cache(num_blocks=4, block_size=2)
+    _fill(c, "a", [1, 2, 3])                 # 2 blocks, 1 indexed
+    c.free("a")
+    assert c.num_cached_blocks == 1
+    # demand the whole pool: the cached block is evicted, index pruned
+    assert c.allocate("big", 8)
+    assert c.num_cached_blocks == 0 and c.prefix_evictions == 1
+    assert _fill.__name__  # (no index entries may survive the evict)
+    assert c.match_prefix([1, 2, 3]) == ([], 0)
+    c.free("big")
+    c.assert_no_leaks()
+
+
+def test_allocate_cached_rolls_back_partial_hold_on_exhaustion():
+    """Satellite: an interrupted admission must free partially-held blocks
+    — with refcounts a leak here pins shared blocks forever."""
+    c = _cache(num_blocks=4, block_size=2)
+    _fill(c, "a", [1, 2, 3])                 # 2 blocks (1 indexed full)
+    snapshot_refs = c.ref_counts.copy()
+    # prefix hit on the full block, but the 5-token tail cannot fit the
+    # 2 remaining blocks: the matched incref must be rolled back
+    assert c.allocate_cached("b", [1, 2, 3, 4, 5, 6, 7], extra=1) is None
+    np.testing.assert_array_equal(c.ref_counts, snapshot_refs)
+    assert "b" not in c.block_tables
+    c.assert_no_leaks()
+    c.free("a")
+    c.assert_no_leaks()
+
+
+# ------------------------------------------------------ engine: prefix hits
+
+
+def _gpt2(seed=0):
+    return build_adapter(
+        "gpt2-tiny",
+        {"n_layer": 2, "n_embd": 64, "n_head": 4, "vocab_size": 96,
+         "block_size": 128, "use_flash_attention": False}, seed=seed)
+
+
+def test_engine_prefix_hit_outputs_byte_equal_gpt2():
+    prompt = list(range(1, 20))
+    cold = LLMEngine(_gpt2(), num_blocks=128, block_size=4, max_batch=4,
+                     prefix_cache=False)
+    (ref, reason), = _drain_outputs(
+        cold, [cold.submit(prompt, SamplingParams(max_tokens=8))])
+    assert reason == "length" and cold.cache.prefix_hit_tokens == 0
+
+    warm = LLMEngine(_gpt2(), num_blocks=128, block_size=4, max_batch=2,
+                     prefix_cache=True)
+    rids = [warm.submit(prompt, SamplingParams(max_tokens=8))
+            for _ in range(5)]
+    outs = _drain_outputs(warm, rids)
+    assert all(o == (ref, "length") for o in outs)
+    assert warm.cache.prefix_hit_tokens > 0
+    assert 0 < warm.cache.hit_rate() < 1
+    warm.cache.assert_no_leaks()
+    assert warm.cache.num_used_blocks == 0
+
+
+def test_engine_prefix_cow_on_aligned_prompt_byte_equal():
+    prompt = [5, 9, 17, 3, 11, 2, 7, 1]      # exactly 2 blocks of 4
+    cold = LLMEngine(_gpt2(), num_blocks=64, block_size=4, max_batch=4,
+                     prefix_cache=False)
+    (ref, _), = _drain_outputs(
+        cold, [cold.submit(prompt, SamplingParams(max_tokens=6))])
+    warm = LLMEngine(_gpt2(), num_blocks=64, block_size=4, max_batch=4,
+                     prefix_cache=True)
+    r1 = warm.submit(prompt, SamplingParams(max_tokens=6))
+    warm.step()                              # r1 prefilled + indexed, alive
+    rids = [r1] + [warm.submit(prompt, SamplingParams(max_tokens=6))
+                   for _ in range(2)]
+    outs = _drain_outputs(warm, rids)
+    assert all(o == (ref, "length") for o in outs)
+    # the cap (match <= len-1) re-prefills the last position of a block r1
+    # still references: the write must copy, not mutate r1's KV
+    assert warm.cache.cow_copies >= 1
+    warm.cache.assert_no_leaks()
+
+
+def test_cow_preempt_interaction_survivor_and_recompute():
+    """Satellite: preempting the youngest of two prefix-sharing sequences
+    must not free blocks the survivor maps, and the recompute must re-hit
+    the prefix cache and still produce byte-equal output."""
+    prompt = [7, 8, 9, 10, 11, 12, 13, 14, 15]
+    ref_eng = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64,
+                        block_size=2, max_batch=4, prefix_cache=False)
+    (ref, _), = _drain_outputs(
+        ref_eng, [ref_eng.submit(prompt, SamplingParams(max_tokens=12))])
+
+    # pool sized to hold ONE fully-grown sequence (9 + 12 + 1 tokens = 11
+    # blocks) but not two, so decoding must preempt the youngest
+    tiny = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=14,
+                     block_size=2, max_batch=4, prefix_cache=True)
+    old = tiny.submit(prompt, SamplingParams(max_tokens=12))
+    tiny.step()                              # prefill + index old's blocks
+    young = tiny.submit(prompt, SamplingParams(max_tokens=12))
+    tiny.step()                              # young admits via the index
+    hits_before = tiny.cache.prefix_hit_tokens
+    assert hits_before > 0
+    while tiny.scheduler.preemptions_total == 0 and tiny.has_work():
+        tiny.step()
+        # the survivor's shared blocks must stay mapped and consistent
+        tiny.cache.assert_no_leaks()
+    assert tiny.scheduler.preemptions_total > 0
+    outs = _drain_outputs(tiny, [old, young])
+    assert all(o == (ref, "length") for o in outs)
+    # the preempted sequence's recompute re-hit the prefix cache
+    assert tiny.cache.prefix_hit_tokens > hits_before
+    tiny.cache.assert_no_leaks()
+    assert tiny.cache.num_used_blocks == 0
+
+
+def test_interrupted_admission_requeues_without_leak():
+    """Satellite: KVCacheExhausted mid-prefill frees the partial hold
+    before the sequence re-queues (leak checked by the integrity sweep)."""
+    eng = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=32, block_size=2,
+                    max_batch=4, prefix_cache=True)
+    ref_rid = eng.submit([1, 2, 3, 4, 5], SamplingParams(max_tokens=6))
+    (ref, _), = _drain_outputs(eng, [ref_rid])
+    eng.cache.assert_no_leaks()
+
+    boom = {"armed": True}
+    orig = eng.cache.write_prefill
+
+    def exploding_write(seq_id, k, v):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise KVCacheExhausted("injected mid-admission failure")
+        return orig(seq_id, k, v)
+
+    eng.cache.write_prefill = exploding_write
+    rid = eng.submit([1, 2, 3, 4, 5], SamplingParams(max_tokens=6))
+    st = eng.step()                          # admission fails, requeues
+    assert st["tokens"] == 0
+    seq = eng.scheduler.get(rid)
+    assert seq is not None and seq.state == "WAITING"
+    eng.cache.assert_no_leaks()              # nothing pinned by the failure
+    (out, reason), = _drain_outputs(eng, [rid])   # next step retries fine
+    assert (out, reason) == (ref, "length")
+    eng.cache.assert_no_leaks()
+    assert eng.cache.num_used_blocks == 0
+
+
+# -------------------------------------------------- engine: speculative
+
+
+def test_spec_decode_byte_equal_partial_acceptance():
+    mk_ref = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64,
+                       block_size=4, max_batch=4, prefix_cache=False)
+    (ref, _), = _drain_outputs(
+        mk_ref, [mk_ref.submit([7, 8, 9], SamplingParams(max_tokens=20))])
+
+    spec = LLMEngine(
+        FakeAdapter(vocab_size=97), num_blocks=64, block_size=4,
+        max_batch=4,
+        draft_adapter=FakeAdapter(vocab_size=97, disagree_every=7),
+        spec_k=4)
+    rids = [spec.submit([7, 8, 9], SamplingParams(max_tokens=20))
+            for _ in range(3)]
+    outs = _drain_outputs(spec, rids)
+    assert all(o == (ref, "length") for o in outs)
+    assert spec.spec_rounds_total > 0
+    assert 0.0 < spec.spec_acceptance() < 1.0    # partial, deterministic
+    # fewer target steps than tokens is the whole point
+    assert spec.steps_total < 3 * 20
+    spec.cache.assert_no_leaks()
+    spec.draft_cache.assert_no_leaks()
+    assert spec.cache.num_used_blocks == 0
+    assert spec.draft_cache.num_used_blocks == 0
+
+
+def test_spec_decode_zero_acceptance_still_byte_equal():
+    mk_ref = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64,
+                       block_size=4, max_batch=2, prefix_cache=False)
+    (ref, _), = _drain_outputs(
+        mk_ref, [mk_ref.submit([3, 5], SamplingParams(max_tokens=10))])
+    # disagree_every=1 perturbs EVERY draft token: worst-case draft
+    spec = LLMEngine(
+        FakeAdapter(vocab_size=97), num_blocks=64, block_size=4,
+        max_batch=2,
+        draft_adapter=FakeAdapter(vocab_size=97, disagree_every=1),
+        spec_k=3)
+    (out, reason), = _drain_outputs(
+        spec, [spec.submit([3, 5], SamplingParams(max_tokens=10))])
+    assert (out, reason) == (ref, "length")
+    assert spec.spec_acceptance() == 0.0
+    spec.draft_cache.assert_no_leaks()
+
+
+def test_spec_decode_eos_inside_accepted_run():
+    base = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64, block_size=4,
+                     max_batch=2, prefix_cache=False)
+    (ref, _), = _drain_outputs(
+        base, [base.submit([7, 8, 9], SamplingParams(max_tokens=20))])
+    eos = ref[5]                             # terminate mid-stream
+    for draft_q in (0, 7):                   # perfect and partial drafts
+        b2 = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64,
+                       block_size=4, max_batch=2, prefix_cache=False)
+        (r2, why2), = _drain_outputs(
+            b2, [b2.submit([7, 8, 9],
+                           SamplingParams(max_tokens=20, eos_id=eos))])
+        spec = LLMEngine(
+            FakeAdapter(vocab_size=97), num_blocks=64, block_size=4,
+            max_batch=2,
+            draft_adapter=FakeAdapter(vocab_size=97,
+                                      disagree_every=draft_q),
+            spec_k=4)
+        (out, why), = _drain_outputs(
+            spec, [spec.submit([7, 8, 9],
+                               SamplingParams(max_tokens=20, eos_id=eos))])
+        assert (out, why) == (r2, why2)
+        assert why == "eos" and out == ref[:6]
+
+
+def test_spec_decode_gpt2_and_llama_byte_equal():
+    """Correctness bar: speculative output == non-cached greedy baseline
+    on the real tiny-model adapters (prefix caching on too)."""
+    for mk in (_gpt2,
+               lambda seed=0: build_adapter(
+                   "llama-tiny", {"vocab_size": 96, "block_size": 64,
+                                  "use_flash_attention": False}, seed=seed)):
+        prompt = [5, 9, 17, 3]
+        cold = LLMEngine(mk(), num_blocks=64, block_size=4, max_batch=4,
+                         prefix_cache=False)
+        (ref, _), = _drain_outputs(
+            cold, [cold.submit(prompt, SamplingParams(max_tokens=8))])
+        spec = LLMEngine(mk(), num_blocks=64, block_size=4, max_batch=4,
+                         prefix_cache=True, draft_adapter=mk(), spec_k=3)
+        rids = [spec.submit(prompt, SamplingParams(max_tokens=8))
+                for _ in range(3)]
+        outs = _drain_outputs(spec, rids)
+        assert all(o == (ref, "length") for o in outs)
+        assert spec.spec_rounds_total > 0
+        spec.cache.assert_no_leaks()
+        spec.draft_cache.assert_no_leaks()
+
+
+def test_spec_sampled_sequences_take_plain_path():
+    """Only greedy sequences speculate; a seeded-temperature sequence in
+    the same batch must sample exactly as without a draft."""
+    sp = dict(max_tokens=8, temperature=1.0, seed=7)
+    plain = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=64,
+                      block_size=4, max_batch=4)
+    (ref, _), = _drain_outputs(
+        plain, [plain.submit([1, 2], SamplingParams(**sp))])
+    spec = LLMEngine(
+        FakeAdapter(vocab_size=97), num_blocks=64, block_size=4,
+        max_batch=4, draft_adapter=FakeAdapter(vocab_size=97), spec_k=4)
+    r_greedy = spec.submit([1, 2], SamplingParams(max_tokens=8))
+    r_temp = spec.submit([1, 2], SamplingParams(**sp))
+    outs = dict(zip((r_greedy, r_temp), _drain_outputs(
+        spec, [r_greedy, r_temp])))
+    assert outs[r_temp] == (ref, "length")
+    assert spec.spec_proposed_total > 0      # the greedy one did speculate
+
+
+# ------------------------------------------------------- pull fast path
+
+
+def test_pull_unknown_and_drained_return_terminal_marker():
+    eng = LLMEngine(FakeAdapter(vocab_size=97), num_blocks=16, block_size=4,
+                    max_batch=2)
+    assert eng.pull("nope") == ([], True, "unknown")
+    rid = eng.submit([1, 2], SamplingParams(max_tokens=3))
+    eng.run_until_drained()
+    toks, done, reason = eng.pull(rid)
+    assert done and len(toks) == 3
+    # drained-and-popped: terminal marker with the TRUE reason, instantly
+    assert eng.pull(rid) == ([], True, "length")
+
+
+def test_replica_pull_unknown_skips_long_poll():
+    async def main():
+        rep = LLMReplica(model="fake", model_config={"vocab_size": 97},
+                         num_blocks=16, block_size=4)
+        t0 = time.perf_counter()
+        out = await rep.llm_pull("missing", wait_s=5.0)
+        dt = time.perf_counter() - t0
+        assert out["done"] and out["finish_reason"] == "unknown"
+        assert dt < 1.0, f"unknown id slept the long poll: {dt:.2f}s"
+
+    asyncio.run(main())
+
+
+def test_replica_spec_and_prefix_plumbing():
+    """deploy-style kwargs reach the engine: draft model, spec_k and
+    prefix_cache toggles."""
+    rep = LLMReplica(model="fake", model_config={"vocab_size": 97},
+                     draft_model="fake",
+                     draft_model_config={"vocab_size": 97,
+                                         "disagree_every": 7},
+                     spec_k=3, prefix_cache=True,
+                     num_blocks=32, block_size=4)
+    assert rep.engine.draft_cache is not None
+    assert rep.engine.spec_k == 3 and rep.engine.prefix_cache_enabled
+    off = LLMReplica(model="fake", model_config={"vocab_size": 97},
+                     prefix_cache=False, num_blocks=32, block_size=4)
+    assert off.engine.draft_cache is None
+    assert not off.engine.prefix_cache_enabled
