@@ -229,6 +229,47 @@ _FLAGS: Dict[str, Any] = {
     "llm_prefix_cache": True,
     "llm_spec_k": 4,
     "llm_draft_model": "",
+    # --- chaos / robustness plane (stability contract) ----------------------
+    # Same contract as the sections above: CI chaos plans and operator
+    # runbooks key on these names (README "Surviving failures").
+    #   chaos_plan               declarative fault-injection plan, JSON:
+    #                            {"seed": s, "rules": [{"site", "action",
+    #                            "after_n"/"after_steps", "every_n",
+    #                            "count", "prob", "delay_s", <match>}]}.
+    #                            "" disarms and the injection sites cost
+    #                            one module attribute read. Drivers publish
+    #                            their env plan to GCS KV (ns "chaos", key
+    #                            "plan") at init so every joining process
+    #                            replays ONE schedule. Site names are a
+    #                            contract — see _private/chaos.py.
+    #   llm_stream_timeout_s     client-side per-pull timeout of a
+    #                            serve.llm token stream (LlmStream); on
+    #                            expiry the stream raises a structured
+    #                            LlmStreamTimeoutError carrying the stream
+    #                            id + tokens received, instead of a raw
+    #                            get() timeout
+    #   serve_failover_retries   resubmission attempts when a replica dies
+    #                            mid-llm-stream (the remaining generation
+    #                            moves to a surviving replica, riding the
+    #                            prefix cache) and the ActorDiedError retry
+    #                            budget of idempotent DeploymentHandle
+    #                            calls; 0 disables failover
+    #   serve_failover_backoff_s      base of the capped exponential
+    #                                 backoff (+/-50% jitter) between
+    #                                 failover attempts
+    #   serve_failover_backoff_max_s  backoff cap
+    #   incident_on_worker_crash publish a worker_crash incident when a
+    #                            worker dies by signal with no recorded
+    #                            kill reason (OOM kills, scale-downs and
+    #                            idle reaps stay incident-free) — the
+    #                            chaos suite asserts exactly one incident
+    #                            per induced kill
+    "chaos_plan": "",
+    "llm_stream_timeout_s": 120.0,
+    "serve_failover_retries": 6,
+    "serve_failover_backoff_s": 0.25,
+    "serve_failover_backoff_max_s": 4.0,
+    "incident_on_worker_crash": True,
     # --- TPU ---------------------------------------------------------------
     # Autodetect TPU chips on this host; override with RTPU_num_tpu_chips.
     "num_tpu_chips": -1,

@@ -68,6 +68,16 @@ bytes, hex-encoded at dump time) and a short detail string/number.
                                  "<seq> hit=<tokens>/<context>"
   llm.spec_verify                one speculative verify round:
                                  "batch=<B> k=<proposed> accepted=<n>"
+  chaos.inject                   the chaos plane fired a fault:
+                                 "<site> <action> rule=<i> <attrs>" —
+                                 tests join these against the incident
+                                 table to assert exactly-one attributed
+                                 incident per induced fault
+  serve.failover                 a serve.llm stream resubmitted its
+                                 remaining generation to a surviving
+                                 replica after its pinned replica died:
+                                 "<app> <old>-><new> tokens=<n>
+                                 attempt=<k>"
   incident.open                  the GCS accepted an incident record
   watchdog.fire                  a stall watchdog tripped locally
 """

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import accelerators
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import runtime_env as renv
 from ray_tpu._private.config import RTPU_CONFIG
@@ -173,6 +174,17 @@ class NodeManager:
             logger.exception("metrics endpoint failed to start")
             self.metrics_port = 0
         await self._register_node()
+        # Chaos plane: arm from the env plan or the one the driver
+        # published to GCS KV, so this raylet replays the cluster schedule.
+        try:
+            if RTPU_CONFIG.chaos_plan:
+                _chaos.load_plan(RTPU_CONFIG.chaos_plan)
+            else:
+                plan = await self.gcs.kv_get(b"chaos", b"plan")
+                if plan:
+                    _chaos.load_plan(plan)
+        except Exception:
+            pass
         if RTPU_CONFIG.dashboard_agent:
             try:
                 self._spawn_agent()
@@ -322,6 +334,15 @@ class NodeManager:
         last_pending: List[dict] = []
         while True:
             try:
+                if _chaos.ARMED:
+                    act = _chaos.hit("raylet.heartbeat",
+                                     node=self.node_id.hex())
+                    if act is not None:
+                        if act["action"] == "delay":
+                            await asyncio.sleep(act["delay_s"])
+                        elif act["action"] == "drop":
+                            await asyncio.sleep(period)
+                            continue  # one silent beat
                 beat = await self.gcs.call(
                     "Heartbeat", {"node_id": self.node_id.binary()}, timeout=10
                 )
@@ -583,8 +604,22 @@ class NodeManager:
                 self._release_lease(lease_id)
         actor_id = self._actor_workers.pop(handle.worker_id, None)
         rc = handle.returncode
-        reason = self._kill_reasons.pop(handle.worker_id, None) or f"exit code {rc}"
+        kill_reason = self._kill_reasons.pop(handle.worker_id, None)
+        reason = kill_reason or f"exit code {rc}"
         _fr.record("worker.death", handle.worker_id, reason[:120])
+        # An UNATTRIBUTED signal death (no recorded kill reason, not a
+        # pool-initiated kill, not shutdown) is a crash worth an incident:
+        # chaos kills, segfaults, external OOM killers. Intentional kills —
+        # ray_tpu.kill, memory-monitor OOM, idle reap, scale-down — all
+        # record a reason or mark the handle first, so they stay
+        # incident-free and the chaos suite can assert exactly one
+        # worker_crash incident per induced kill.
+        if (kill_reason is None and isinstance(rc, int) and rc < 0
+                and not getattr(handle, "expected_death", False)
+                and not getattr(self, "_draining", False)
+                and RTPU_CONFIG.incident_on_worker_crash):
+            asyncio.ensure_future(self._report_worker_crash(
+                handle, actor_id, rc))
         # Forensics: the dead worker's flight-recorder file (incrementally
         # appended while it lived, so it exists even after SIGKILL) — its
         # tail rides the death report into death_cause / ActorDiedError, so
@@ -609,6 +644,31 @@ class NodeManager:
                 "reason": reason,
             },
         )
+
+    async def _report_worker_crash(self, handle, actor_id, rc: int):
+        """Publish a worker_crash incident for an unattributed signal
+        death (see _on_worker_death). Attribution: node, pid, signal,
+        actor id, plus the worker's flight tail."""
+        try:
+            from ray_tpu._private.watchdog import build_incident
+
+            detail = f"worker pid={handle.pid} died by signal {-rc}"
+            if actor_id:
+                detail += f" (actor {bytes(actor_id).hex()[:12]})"
+            tail = self._worker_flight_tail(handle.pid)
+            if tail:
+                detail += f"\nlast flight-recorder events:\n{tail}"
+            inc = build_incident(
+                "worker_crash", "raylet", detail,
+                node_id=self.node_id.hex(),
+                worker_id=bytes(handle.worker_id).hex()
+                if handle.worker_id else "",
+            )
+            inc["pid"] = handle.pid
+            await self.gcs.call("ReportIncident", {"incident": inc},
+                                timeout=10)
+        except Exception:
+            pass
 
     def _worker_memory_tail(self, handle) -> str:
         from ray_tpu._private import memory_report as _mr
@@ -2622,6 +2682,8 @@ class NodeManager:
         return {"ok": True}
 
     async def shutdown(self):
+        # Worker deaths during teardown are expected, never incidents.
+        self._draining = True
         _fr.flush_now()
         for t in self._bg:
             t.cancel()

@@ -47,6 +47,9 @@ class WorkerHandle:
     # spec; the creation result arrives inside the child's RegisterWorker
     actor_ready: Optional[asyncio.Event] = None
     actor_result: Optional[dict] = None
+    # pool-initiated kill (idle reap, job teardown, shutdown): the death
+    # callback must not publish a worker_crash incident for it
+    expected_death: bool = False
 
 
 class WorkerPool:
@@ -190,6 +193,15 @@ class WorkerPool:
     async def start_worker(
         self, job_id: bytes, env_overrides=None, spawn_extra: Optional[dict] = None
     ) -> WorkerHandle:
+        from ray_tpu._private import chaos as _chaos
+
+        if _chaos.ARMED:
+            act = _chaos.hit("raylet.spawn", job=job_id.hex())
+            if act is not None:
+                if act["action"] == "delay":
+                    await asyncio.sleep(act["delay_s"])
+                elif act["action"] in ("fail", "error", "drop"):
+                    raise RuntimeError("chaos: worker spawn failed (injected)")
         if env_overrides and ("RTPU_SPAWN_PYTHON" in env_overrides
                               or "RTPU_SPAWN_PREFIX" in env_overrides):
             # conda / container runtime_env: the worker must run under a
@@ -359,6 +371,8 @@ class WorkerPool:
             self._idle.append(handle)
 
     async def kill_worker(self, handle: WorkerHandle):
+        # Pool-initiated: the death callback must not treat it as a crash.
+        handle.expected_death = True
         if handle.pid:
             if self._fs_proc is not None and self._fs_proc.returncode is None:
                 try:
@@ -426,6 +440,7 @@ class WorkerPool:
             | set(self._by_pid.values())
         )
         for h in handles:
+            h.expected_death = True
             if h.pid:
                 self._kill_pid(h.pid)
         if self._fs_proc is not None and self._fs_proc.returncode is None:

@@ -46,6 +46,8 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 import cloudpickle
 import msgpack
 
+from ray_tpu._private import chaos as _chaos
+
 MSG_REQUEST = 0
 MSG_RESPONSE = 1
 MSG_ERROR = 2
@@ -278,6 +280,21 @@ class RpcServer:
                         return
                     continue
                 if mtype == MSG_REQUEST:
+                    if _chaos.ARMED:
+                        act = _chaos.hit("rpc.recv", method=method)
+                        if act is not None:
+                            if act["action"] == "drop":
+                                continue  # swallowed: the caller times out
+                            if act["action"] == "dup":
+                                # receiver idempotence under at-least-once
+                                # delivery: dispatch the same request twice
+                                asyncio.ensure_future(self._dispatch(
+                                    writer, lock, seq, method, payload))
+                            elif act["action"] == "delay":
+                                asyncio.ensure_future(self._dispatch_later(
+                                    act["delay_s"], writer, lock, seq,
+                                    method, payload))
+                                continue
                     asyncio.ensure_future(
                         self._dispatch(writer, lock, seq, method, payload)
                     )
@@ -328,6 +345,12 @@ class RpcServer:
             await _read_oob_into(reader, memoryview(scratch), nbytes)
             payload["_oob"] = scratch
         return payload
+
+    async def _dispatch_later(self, delay_s, writer, lock, seq, method,
+                              payload):
+        """Chaos-delayed dispatch (rpc.recv delay action)."""
+        await asyncio.sleep(delay_s)
+        await self._dispatch(writer, lock, seq, method, payload)
 
     async def _run_notify(self, handler, payload):
         try:
@@ -472,8 +495,14 @@ class RpcClient:
         self._pending[seq] = fut
         if oob_dest is not None:
             self._pending_oob_dest[seq] = oob_dest
+        chaos_act = _chaos.hit("rpc.send", method=method) if _chaos.ARMED \
+            else None
         try:
-            if oob is not None:
+            if chaos_act is not None and chaos_act["action"] == "delay":
+                await asyncio.sleep(chaos_act["delay_s"])
+            if chaos_act is not None and chaos_act["action"] == "drop":
+                pass  # never sent: the caller's timeout is the symptom
+            elif oob is not None:
                 hdr, mv = _pack_oob(MSG_REQUEST_OOB, seq, method, payload, oob)
                 async with self._lock:
                     self._writer.write(hdr)
@@ -483,6 +512,8 @@ class RpcClient:
                 frame = _pack([MSG_REQUEST, seq, method, payload])
                 async with self._lock:
                     self._writer.write(frame)
+                    if chaos_act is not None and chaos_act["action"] == "dup":
+                        self._writer.write(frame)
                     await self._writer.drain()
             if timeout is None:
                 return await fut

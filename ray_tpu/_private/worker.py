@@ -24,6 +24,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import runtime_env as renv, serialization, task_spec as ts
 from ray_tpu._private.config import RTPU_CONFIG
@@ -379,6 +380,13 @@ class CoreWorker:
 
     def _finish_init(self):
         self.io.run(self._connect())
+        # Chaos plane: drivers publish their env plan to GCS KV so the
+        # whole cluster replays one schedule; workers arm from the env or
+        # the published plan when they join.
+        try:
+            _chaos.sync_with_gcs(self.gcs, publish=(self.mode == MODE_DRIVER))
+        except Exception:
+            pass
         if self.session_dir:
             # Flight-recorder forensics file: incrementally appended by the
             # flush loop so the tail survives SIGKILL; the raylet attaches
@@ -869,6 +877,13 @@ class CoreWorker:
         plasma/client.cc). `buffers` are the raw out-of-band views from
         serialize() — never pre-materialized bytes. Returns the object's
         byte size."""
+        if _chaos.ARMED:
+            act = _chaos.hit("plasma.write")
+            if act is not None:
+                if act["action"] == "delay":
+                    time.sleep(act["delay_s"])
+                elif act["action"] in ("error", "fail"):
+                    raise OSError("chaos: plasma write failed (injected)")
         size = serialization.blob_size(pickle_bytes, buffers)
         try:
             dest = self.plasma.create(oid, size)

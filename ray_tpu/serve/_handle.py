@@ -122,11 +122,16 @@ class StreamingResponse:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 idempotent: bool = False):
         self.deployment_name = deployment_name
         self._method = method_name
         self._model_id = multiplexed_model_id
         self._stream = stream
+        # idempotent methods opt into bounded ActorDiedError retry: a call
+        # that dies with its replica is transparently re-dispatched to a
+        # survivor (RTPU_serve_failover_retries, capped backoff)
+        self._idempotent = idempotent
         self._lock = threading.Lock()
         self._replicas: List[Any] = []
         self._replica_names: List[str] = []
@@ -138,24 +143,26 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self._method, self._model_id,
-                 self._stream))
+                 self._stream, self._idempotent))
 
     def options(self, method_name: Optional[str] = None, *,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                idempotent: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._model_id,
             self._stream if stream is None else stream,
+            self._idempotent if idempotent is None else idempotent,
         )
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, name, self._model_id,
-                                self._stream)
+                                self._stream, self._idempotent)
 
     def _apply_names(self, names: List[str], version: int):
         import ray_tpu
@@ -315,6 +322,15 @@ class DeploymentHandle:
         self._done(replica_name)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        retries = 0
+        if self._idempotent and not self._stream:
+            from ray_tpu._private.config import RTPU_CONFIG
+
+            retries = max(0, int(RTPU_CONFIG.serve_failover_retries))
+        return self._remote(args, kwargs, retries)
+
+    def _remote(self, args: tuple, kwargs: dict,
+                died_retries: int = 0) -> DeploymentResponse:
         t0 = time.time()
         deadline = t0 + 60
         last_err: Optional[Exception] = None
@@ -351,7 +367,8 @@ class DeploymentHandle:
                 # on the ref's completion via a daemon thread-free path: the
                 # response object decrements on result()).
                 resp = DeploymentResponse(ref)
-                _attach_done(resp, self, idx, t0)
+                _attach_done(resp, self, idx, t0, args=args, kwargs=kwargs,
+                             died_retries=died_retries)
                 try:
                     _metrics()["requests"].inc(
                         1, tags={"deployment": self.deployment_name})
@@ -369,26 +386,66 @@ class DeploymentHandle:
         )
 
 
+def _is_actor_death(e: BaseException) -> bool:
+    from ray_tpu.exceptions import (
+        ActorDiedError,
+        ActorUnavailableError,
+        TaskError,
+    )
+
+    if isinstance(e, TaskError):
+        e = e.cause
+    return isinstance(e, (ActorDiedError, ActorUnavailableError))
+
+
 def _attach_done(resp: DeploymentResponse, handle: DeploymentHandle, idx: int,
-                 t0: Optional[float] = None):
+                 t0: Optional[float] = None, *, args: tuple = (),
+                 kwargs: Optional[dict] = None, died_retries: int = 0):
     original = resp.result
     done = {"fired": False}
     deployment = handle.deployment_name
 
+    def _settle():
+        if not done["fired"]:
+            done["fired"] = True
+            handle._done(idx)
+            if t0 is not None:
+                # caller-observed e2e latency, observed once per request
+                # at first resolution (repeat result() calls are reads)
+                try:
+                    _metrics()["latency"].observe(
+                        time.time() - t0, tags={"deployment": deployment})
+                except Exception:
+                    pass
+
     def result(timeout: Optional[float] = None):
         try:
-            return original(timeout)
-        finally:
-            if not done["fired"]:
-                done["fired"] = True
-                handle._done(idx)
-                if t0 is not None:
-                    # caller-observed e2e latency, observed once per request
-                    # at first resolution (repeat result() calls are reads)
-                    try:
-                        _metrics()["latency"].observe(
-                            time.time() - t0, tags={"deployment": deployment})
-                    except Exception:
-                        pass
+            out = original(timeout)
+        except BaseException as e:
+            if died_retries > 0 and _is_actor_death(e):
+                # bounded retry for idempotent methods: the replica died
+                # with our call in flight — back off (capped exponential:
+                # replacements take seconds to appear), re-pick a survivor
+                # and re-dispatch
+                _settle()
+                from ray_tpu._private.config import RTPU_CONFIG
+
+                attempt = max(
+                    0, int(RTPU_CONFIG.serve_failover_retries) - died_retries)
+                time.sleep(min(RTPU_CONFIG.serve_failover_backoff_max_s,
+                               RTPU_CONFIG.serve_failover_backoff_s
+                               * (2 ** attempt))
+                           * (0.5 + random.random() / 2))
+                from ray_tpu.serve.rpc_ingress import _note_failover
+
+                _note_failover(deployment)
+                handle._refresh_replicas(force=True)
+                return handle._remote(
+                    args, dict(kwargs or {}), died_retries - 1
+                ).result(timeout)
+            _settle()
+            raise
+        _settle()
+        return out
 
     resp.result = result

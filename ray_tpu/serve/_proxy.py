@@ -123,13 +123,27 @@ class ProxyActor:
             def _open():
                 return handle.remote(*args, **kwargs)
 
+            # the dedicated stream pool: slow streams must never starve
+            # routing/non-streaming traffic out of self._pool. Submit the
+            # CONCURRENT future (not run_in_executor) so a timeout can
+            # still observe the late result and close it — wait_for's
+            # cancellation never reaches a running pool thread.
+            fut = self._stream_pool.submit(_open)
             try:
-                # the dedicated stream pool: slow streams must never starve
-                # routing/non-streaming traffic out of self._pool
                 resp = await asyncio.wait_for(
-                    loop.run_in_executor(self._stream_pool, _open),
-                    timeout + 10,
-                )
+                    asyncio.wrap_future(fut), timeout + 10)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                # an open that completes after the client gave up would
+                # hold its replica in-flight slot forever: close it
+                def _abandon(f):
+                    try:
+                        f.result().close()
+                    except BaseException:
+                        pass
+
+                fut.add_done_callback(_abandon)
+                return {"error": "timed out opening stream",
+                        "app_error": True}
             except Exception as e:  # noqa: BLE001
                 return {"error": str(e), "app_error": True}
             import threading as _threading
@@ -267,7 +281,6 @@ class ProxyActor:
             prompt = req.get("prompt")
         sampling = req.get("sampling") or {}
         timeout = min(float(req.get("timeout") or 60.0), 300.0)
-        loop = asyncio.get_running_loop()
 
         def _open():
             import ray_tpu
@@ -281,12 +294,35 @@ class ProxyActor:
                 )
                 return name, replica, out["request_id"]
             except BaseException:
+                # death/timeout between pick_replica and registration: the
+                # p2c in-flight slot must come back exactly once (here —
+                # the stream record that would normally own it was never
+                # created)
                 handle.release(name)
                 raise
 
+        fut = self._stream_pool.submit(_open)
         try:
             name, replica, rid = await asyncio.wait_for(
-                loop.run_in_executor(self._stream_pool, _open), timeout + 10)
+                asyncio.wrap_future(fut), timeout + 10)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            # the pool thread may still be mid-open; if it eventually
+            # succeeds, nobody will ever pull this stream — release the
+            # slot and cancel the submitted sequence so its KV frees
+            def _abandon(f):
+                try:
+                    name, replica, rid = f.result()
+                except BaseException:
+                    return  # _open released on its own failure path
+                handle.release(name)
+                try:
+                    replica.llm_call.remote("llm_cancel", (rid,), {})
+                except Exception:
+                    pass
+
+            fut.add_done_callback(_abandon)
+            return {"error": "timed out opening llm stream",
+                    "app_error": True}
         except Exception as e:  # noqa: BLE001
             return self._llm_error(e)
         import time as _time
@@ -303,11 +339,19 @@ class ProxyActor:
     def _llm_error(e) -> dict:
         """Typed error reply; admission rejections stay structured so the
         client can distinguish backpressure (retry with backoff / route
-        elsewhere) from a real failure."""
-        from ray_tpu.exceptions import TaskError
+        elsewhere) from a real failure, and replica deaths are tagged so
+        the client's failover path can resubmit instead of surfacing a raw
+        ActorDiedError."""
+        from ray_tpu.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+            TaskError,
+        )
 
         cause = e.cause if isinstance(e, TaskError) else e
         out = {"error": str(cause), "app_error": True}
+        if isinstance(cause, (ActorDiedError, ActorUnavailableError)):
+            out["replica_died"] = True
         to_dict = getattr(cause, "to_dict", None)
         if callable(to_dict) and getattr(cause, "queue_depth", None) is not None:
             out.update(to_dict())
@@ -342,8 +386,13 @@ class ProxyActor:
             out = await asyncio.wait_for(
                 loop.run_in_executor(self._stream_pool, _pull), wait_s + 40)
         except Exception as e:  # noqa: BLE001
-            self._drop_llm_stream(req.get("stream_id"), cancel=True)
-            return self._llm_error(e)
+            err = self._llm_error(e)
+            # replica death: the stream record goes (slot released exactly
+            # once via the pop in _drop_llm_stream) but there is nothing
+            # left to cancel — the sequence died with the replica
+            self._drop_llm_stream(req.get("stream_id"),
+                                  cancel=not err.get("replica_died"))
+            return err
         if out["done"]:
             self._drop_llm_stream(req.get("stream_id"), cancel=False)
         from ray_tpu._private.rpc import OobPayload

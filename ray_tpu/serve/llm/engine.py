@@ -742,13 +742,27 @@ class LLMReplica:
     async def _run_loop(self):
         import asyncio
 
+        from ray_tpu._private import chaos as _chaos
+
         loop = asyncio.get_running_loop()
         while True:
             if self.engine.has_work():
-                await loop.run_in_executor(None, self.engine.step)
+                stats = await loop.run_in_executor(None, self.engine.step)
                 # wake every pull waiting on this step's tokens
                 tick, self._tick = self._tick, asyncio.Event()
                 tick.set()
+                # Chaos site: fires only on PRODUCTIVE steps so
+                # "after_steps" counts generation progress, deterministic
+                # across replays (SIGKILL mid-stream, a hung step loop, a
+                # step-loop crash are the faults the failover path and the
+                # controller's health check must absorb).
+                if _chaos.ARMED and stats.get("batch_size", 0) > 0:
+                    act = _chaos.hit(
+                        "replica.step",
+                        deployment=self.engine._tags["deployment"],
+                        replica=self.engine._tags["replica"])
+                    if act is not None:
+                        await self._apply_chaos(act)
             else:
                 self._wake.clear()
                 # wake promptly on submit; the timeout keeps the loop
@@ -757,6 +771,31 @@ class LLMReplica:
                     await asyncio.wait_for(self._wake.wait(), 1.0)
                 except asyncio.TimeoutError:
                     pass
+
+    @staticmethod
+    async def _apply_chaos(act: dict):
+        """Interpret a fired replica.step rule: kill (SIGKILL this
+        process, flushing the flight ring first so the death report
+        carries the tail), hang (stall the step loop — the controller's
+        health staleness check replaces us), or error (step loop dies —
+        check_health fails)."""
+        import asyncio
+
+        action = act["action"]
+        if action == "kill":
+            import os
+            import signal
+
+            from ray_tpu._private import flight_recorder as _fr
+
+            _fr.flush_now()
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif action == "hang":
+            await asyncio.sleep(act["delay_s"] or 3600.0)
+        elif action == "delay":
+            await asyncio.sleep(act["delay_s"])
+        elif action == "error":
+            raise RuntimeError("chaos: replica step loop error (injected)")
 
     @staticmethod
     async def _wait_event(ev, timeout: float):
@@ -851,6 +890,26 @@ class LLMReplica:
 
     async def stats(self) -> dict:
         return {"model": self.model, **self.engine.stats()}
+
+    async def llm_integrity(self) -> dict:
+        """Storm-survival invariant probe: cross-check every KV block
+        (target AND draft cache) against the refcount/index/free-list
+        bookkeeping. The chaos suite asserts ``problems == []`` and
+        ``used_blocks == 0`` on every surviving replica after a storm —
+        the serve-plane analogue of the PR 7 plasma leak sweep."""
+        with self.engine._lock:
+            problems = list(self.engine.cache.check_integrity())
+            used = self.engine.cache.num_used_blocks
+            if self.engine.draft_cache is not None:
+                problems += [f"draft: {p}" for p in
+                             self.engine.draft_cache.check_integrity()]
+                used += self.engine.draft_cache.num_used_blocks
+            return {
+                "problems": problems,
+                "used_blocks": used,
+                "waiting": len(self.engine.scheduler.waiting),
+                "running": len(self.engine.scheduler.running),
+            }
 
     def check_health(self):
         if self._loop_task is not None and self._loop_task.done():

@@ -39,6 +39,55 @@ class RpcBackpressureError(RpcIngressError):
         self.kv_utilization = kv_utilization
 
 
+class ReplicaDiedMidStreamError(RpcIngressError):
+    """The replica pinned to this llm stream died and failover was
+    disabled or exhausted its retry budget. Carries everything a caller
+    needs to resume by hand: the stream id and the tokens generated so
+    far (resubmit ``prompt + tokens_generated`` with the remaining
+    budget — exactly what the built-in failover does automatically)."""
+
+    def __init__(self, message: str, stream_id: str = "",
+                 tokens_generated=None):
+        super().__init__(message)
+        self.stream_id = stream_id
+        self.tokens_generated = list(tokens_generated or [])
+
+
+class LlmStreamTimeoutError(RpcIngressError, TimeoutError):
+    """A token pull exceeded ``RTPU_llm_stream_timeout_s`` (stability
+    contract flag). Structured — stream id + tokens received — instead of
+    the raw transport timeout, so callers can tell a stalled stream from
+    a dead connection and decide whether the partial output is usable."""
+
+    def __init__(self, message: str, stream_id: str = "",
+                 tokens_received: int = 0, timeout_s: float = 0.0):
+        super().__init__(message)
+        self.stream_id = stream_id
+        self.tokens_received = tokens_received
+        self.timeout_s = timeout_s
+
+
+_failover_counter = None
+
+
+def _note_failover(deployment: str):
+    """Bump ``ray_tpu_serve_failovers_total`` (stability contract,
+    util/metrics.py) — one per successful mid-stream resubmission or
+    idempotent-handle ActorDiedError retry."""
+    global _failover_counter
+    try:
+        from ray_tpu.util.metrics import Counter
+
+        if _failover_counter is None:
+            _failover_counter = Counter(
+                "ray_tpu_serve_failovers_total",
+                "mid-stream llm failovers + idempotent handle retries",
+                tag_keys=("deployment",))
+        _failover_counter.inc(1, tags={"deployment": deployment})
+    except Exception:
+        pass
+
+
 class RpcIngressClient:
     def __init__(self, host: str, port: int):
         self._io = IoThread.current()
@@ -97,16 +146,27 @@ class RpcIngressClient:
         ``sampling``: max_tokens, temperature, top_k, eos_id, seed.
         Raises :class:`RpcBackpressureError` when admission is shed.
         """
+        if isinstance(prompt, str):
+            ids = list(prompt.encode("utf-8"))
+        else:
+            ids = [int(t) for t in prompt]
+        reply = self._llm_open(app, ids, sampling, timeout)
+        return LlmStream(self, reply["stream_id"], timeout,
+                         max_tokens_per_pull, app=app, prompt_ids=ids,
+                         sampling=sampling)
+
+    def _llm_open(self, app: str, ids, sampling: dict,
+                  timeout: float) -> dict:
+        """One ``ServeLlmOpen`` round-trip (prompt as a raw OOB frame);
+        raises the structured admission/ingress errors. Shared by the
+        initial open and the failover resubmission path."""
         import numpy as np
 
-        if isinstance(prompt, str):
-            ids = np.asarray(list(prompt.encode("utf-8")), dtype=np.int32)
-        else:
-            ids = np.asarray(list(prompt), dtype=np.int32)
         req = {"app": app, "timeout": timeout, "sampling": sampling}
         reply = self._io.run(
-            self._client.call("ServeLlmOpen", req, timeout=timeout,
-                              oob=ids.tobytes()),
+            self._client.call(
+                "ServeLlmOpen", req, timeout=timeout,
+                oob=np.asarray(ids, dtype=np.int32).tobytes()),
             timeout=timeout + 10,
         )
         if reply.get("error"):
@@ -118,8 +178,7 @@ class RpcIngressClient:
                     kv_utilization=reply.get("kv_utilization", 0.0),
                 )
             raise RpcIngressError(reply["error"])
-        return LlmStream(self, reply["stream_id"], timeout,
-                         max_tokens_per_pull)
+        return reply
 
     def close(self):
         try:
@@ -191,10 +250,25 @@ class LlmStream:
     int token ids. Each pull is one ``ServeLlmNext`` round-trip whose token
     payload arrives as a raw out-of-band frame (int32 little-endian) —
     decoded here with one ``np.frombuffer``, zero copies upstream of the
-    socket. ``finish_reason`` is set once the stream ends."""
+    socket. ``finish_reason`` is set once the stream ends.
+
+    **Failover**: when the pinned replica dies mid-stream (the proxy
+    replies with ``replica_died``), the remaining generation is
+    transparently resubmitted to a surviving replica with capped
+    exponential backoff + jitter — the resubmitted prompt is
+    ``prompt + tokens_generated_so_far``, so recovery rides the prefix
+    cache and only re-prefills the un-shared tail, and greedy streams stay
+    byte-equal to a fault-free run (the engine's recompute-equivalence
+    property). Budget: ``RTPU_serve_failover_retries`` attempts per death;
+    exhaustion raises :class:`ReplicaDiedMidStreamError` carrying the
+    tokens generated so far. Pulls are bounded by
+    ``RTPU_llm_stream_timeout_s`` and raise a structured
+    :class:`LlmStreamTimeoutError` on expiry."""
 
     def __init__(self, client: RpcIngressClient, stream_id: str,
-                 timeout: float, max_tokens_per_pull: int = 0):
+                 timeout: float, max_tokens_per_pull: int = 0, *,
+                 app: str | None = None, prompt_ids=None,
+                 sampling: dict | None = None):
         self._client = client
         self._sid = stream_id
         self._timeout = timeout
@@ -202,38 +276,131 @@ class LlmStream:
         self._buf: list = []
         self._done = False
         self._owns_client = False
+        self._app = app
+        self._prompt = list(prompt_ids) if prompt_ids is not None else None
+        self._sampling = dict(sampling or {})
+        self._received: list = []  # all tokens this stream has produced
+        self.failovers = 0
         self.finish_reason: str | None = None
 
     def __iter__(self):
         return self
 
     def __next__(self) -> int:
+        import asyncio
+        import concurrent.futures
+
         import numpy as np
+
+        from ray_tpu._private.config import RTPU_CONFIG
 
         while not self._buf:
             if self._done:
                 self._finish()
                 raise StopIteration
-            reply = self._client._io.run(
-                self._client._client.call(
-                    "ServeLlmNext",
-                    {"stream_id": self._sid,
-                     "max_tokens": self._max_tokens},
-                    timeout=self._timeout,
-                ),
-                timeout=self._timeout + 10,
-            )
-            if reply.get("error"):
+            pull_timeout = min(float(RTPU_CONFIG.llm_stream_timeout_s),
+                               self._timeout)
+            try:
+                reply = self._client._io.run(
+                    self._client._client.call(
+                        "ServeLlmNext",
+                        {"stream_id": self._sid,
+                         "max_tokens": self._max_tokens},
+                        timeout=pull_timeout,
+                    ),
+                    timeout=pull_timeout + 10,
+                )
+            except (asyncio.TimeoutError,
+                    concurrent.futures.TimeoutError) as e:
                 self._done = True
                 self._finish()
+                raise LlmStreamTimeoutError(
+                    f"llm stream {self._sid} pull exceeded "
+                    f"{pull_timeout:.0f}s (RTPU_llm_stream_timeout_s) after "
+                    f"{len(self._received)} tokens",
+                    stream_id=self._sid,
+                    tokens_received=len(self._received),
+                    timeout_s=pull_timeout,
+                ) from e
+            if reply.get("error"):
+                if reply.get("replica_died") and self._failover():
+                    continue  # resubmitted on a surviving replica
+                self._done = True
+                self._finish()
+                if reply.get("replica_died"):
+                    raise ReplicaDiedMidStreamError(
+                        f"replica died mid-stream after "
+                        f"{len(self._received)} tokens: {reply['error']}",
+                        stream_id=self._sid,
+                        tokens_generated=self._received,
+                    )
                 raise RpcIngressError(reply["error"])
             raw = reply.get("_oob") or b""
-            self._buf.extend(np.frombuffer(bytes(raw), dtype=np.int32)
-                             .tolist())
+            toks = np.frombuffer(bytes(raw), dtype=np.int32).tolist()
+            self._buf.extend(toks)
+            self._received.extend(toks)
             self._done = reply["done"]
             if self._done:
                 self.finish_reason = reply.get("finish_reason")
         return self._buf.pop(0)
+
+    def _failover(self) -> bool:
+        """Resubmit the remaining generation to a surviving replica.
+        Returns True when a new stream is open (the pull loop continues
+        against it); False when failover is impossible or exhausted."""
+        import random
+        import time
+
+        from ray_tpu._private import flight_recorder as _fr
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        retries = int(RTPU_CONFIG.serve_failover_retries)
+        if self._prompt is None or self._app is None or retries <= 0:
+            return False
+        sampling = dict(self._sampling)
+        max_tokens = int(sampling.get("max_tokens", 0) or 0)
+        if max_tokens:
+            remaining = max_tokens - len(self._received)
+            if remaining <= 0:
+                # the death raced the final pull: everything was generated
+                self._done = True
+                self.finish_reason = "length"
+                return True
+            sampling["max_tokens"] = remaining
+        # prompt + generated-so-far: the surviving replica re-prefills only
+        # the blocks the prefix cache does not already share
+        prompt = list(self._prompt) + [int(t) for t in self._received]
+        base = float(RTPU_CONFIG.serve_failover_backoff_s)
+        cap = float(RTPU_CONFIG.serve_failover_backoff_max_s)
+        last: Exception | None = None
+        for attempt in range(retries):
+            # capped exponential backoff with +/-50% jitter: replacement
+            # replicas take seconds to boot, and a storm of failing-over
+            # clients must not synchronize into retry waves
+            time.sleep(min(cap, base * (2 ** attempt))
+                       * (0.5 + random.random() / 2))
+            try:
+                reply = self._client._llm_open(
+                    self._app, prompt, sampling, self._timeout)
+            except Exception as e:  # noqa: BLE001 — includes backpressure
+                last = e            # and no-replicas-yet; retry with backoff
+                continue
+            old = self._sid
+            self._sid = reply["stream_id"]
+            self.failovers += 1
+            _fr.record("serve.failover", b"",
+                       f"{self._app} {old}->{self._sid} "
+                       f"tokens={len(self._received)} attempt={attempt + 1}")
+            _note_failover(self._app)
+            return True
+        self._done = True
+        self._finish()
+        raise ReplicaDiedMidStreamError(
+            f"replica died mid-stream after {len(self._received)} tokens "
+            f"and failover exhausted {retries} attempts: {last}",
+            stream_id=self._sid,
+            tokens_generated=self._received,
+        )
 
     # async iteration: the blocking pull runs in the default executor so
     # `async for tok in serve.llm.stream(...)` works from an event loop

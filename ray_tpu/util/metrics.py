@@ -67,6 +67,18 @@ change (add new series instead). The stable set:
     ray_tpu_perf_compile_storms_total  counter — jit_cache_miss_storm
                                        incidents raised by the watchdog
 
+  chaos / robustness plane (_private/chaos.py + serve failover paths)
+    ray_tpu_chaos_injections_total     counter, labels: site, action —
+                                       faults fired by the chaos plane
+                                       (zero unless RTPU_chaos_plan is
+                                       armed)
+    ray_tpu_serve_failovers_total      counter, labels: deployment —
+                                       mid-stream llm failovers (the
+                                       remaining generation resubmitted
+                                       to a surviving replica) plus
+                                       ActorDiedError retries of
+                                       idempotent DeploymentHandle calls
+
   memory observability plane (raylet _collect_metrics, labels: node)
     ray_tpu_object_store_pinned_bytes  gauge — bytes held by pinned
                                        primary copies in this node's
@@ -81,10 +93,10 @@ change (add new series instead). The stable set:
                                        node (worker = sum over workers)
 
 The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* /
-RTPU_memory_* / RTPU_llm_* config flags are likewise a stability
-contract — see the profiling-plane, perf-regression-plane,
-memory-observability-plane and serve.llm sections of
-``ray_tpu/_private/config.py``.
+RTPU_memory_* / RTPU_llm_* / RTPU_chaos_* / RTPU_serve_failover_* config
+flags are likewise a stability contract — see the profiling-plane,
+perf-regression-plane, memory-observability-plane, serve.llm and
+chaos-plane sections of ``ray_tpu/_private/config.py``.
 """
 
 from __future__ import annotations
