@@ -322,6 +322,61 @@ def _bench_serve_llm_spec(quick: bool, reps: int) -> dict:
     return out
 
 
+def _bench_submit_storm(quick: bool, reps: int, min_time: float) -> dict:
+    """many_drivers_submit_storm: K driver-like client actors (separate
+    processes, each with its own CoreWorker) concurrently flood the node
+    with tiny no-arg tasks — the many-drivers control-plane shape ROADMAP
+    item 1 names. Measured twice on identical fresh clusters: once with
+    the plasma-backed submit ring (the default path: specs memcpy into
+    shared memory, the raylet drains batches, one doorbell RPC per
+    empty→non-empty transition) and once with the ring disabled
+    (``RTPU_submit_ring_slots=0``: one PushTask RPC write per batch from
+    each submitter). The pair is the ring-vs-RPC A/B the perf gate tracks;
+    on a 1-core box both sides timeshare the core, so the ratio
+    understates the design by the core count (same caveat as the other
+    multi-process rows). Quick mode keeps the FULL storm geometry and only
+    drops reps/min_time (the serve_llm_prefix precedent) — a smaller storm
+    measures a different contention shape and would make quick runs
+    incomparable with the committed ledger rows."""
+    import os
+
+    import ray_tpu
+
+    n_cli = 4
+    per = 200
+    out = {}
+    for key, ring in (("many_drivers_submit_storm", True),
+                      ("many_drivers_submit_storm_rpc", False)):
+        saved = os.environ.get("RTPU_submit_ring_slots")
+        if not ring:
+            os.environ["RTPU_submit_ring_slots"] = "0"
+        try:
+            ray_tpu.init(num_cpus=8)
+            try:
+                _small, _a, _aa, Client = _define_remotes()
+                clients = [Client.remote([]) for _ in range(n_cli)]
+                ray_tpu.get([c.task_batch.remote(1) for c in clients])
+                out[key] = timeit(
+                    key,
+                    lambda: ray_tpu.get(
+                        [c.task_batch.remote(per) for c in clients]),
+                    multiplier=n_cli * per, min_time=min_time, reps=reps,
+                    key=key)
+            finally:
+                ray_tpu.shutdown()
+        finally:
+            if not ring:
+                if saved is None:
+                    os.environ.pop("RTPU_submit_ring_slots", None)
+                else:
+                    os.environ["RTPU_submit_ring_slots"] = saved
+    if out.get("many_drivers_submit_storm_rpc"):
+        print(f"  submit storm ring/rpc ratio: "
+              f"{out['many_drivers_submit_storm'] / out['many_drivers_submit_storm_rpc']:.2f} "
+              f"({n_cli} drivers x {per}/batch)")
+    return out
+
+
 def _define_remotes():
     import ray_tpu
 
@@ -399,6 +454,11 @@ def run_benches(quick: bool = False, only: str = None) -> dict:
             or sel("serve_llm_spec_baseline_tokens_per_s")
             or sel("serve_llm_spec_acceptance")):
         results.update(_bench_serve_llm_spec(quick, reps=_REPS))
+    # submit-storm rows boot their own clusters (the ring-vs-RPC A/B needs
+    # a different env per side), so they run outside the shared init below
+    if sel("many_drivers_submit_storm") or sel("many_drivers_submit_storm_rpc"):
+        results.update(_bench_submit_storm(quick, reps=_REPS,
+                                           min_time=min_time))
     cluster_metrics = (
         "single_client_tasks_sync", "single_client_tasks_async",
         "wait_1k_refs", "multi_client_tasks_async", "1_1_actor_calls_sync",
@@ -639,6 +699,7 @@ def main():
         "| (default) | ±40% | ±25% | single runs swing ±25-30% on this box |",
         "| multi_client_tasks_async | ±50% | ±35% | processes timeshare one core |",
         "| n_n_actor_calls_async | ±50% | ±35% | processes timeshare one core |",
+        "| many_drivers_submit_storm(_rpc) | ±50% | ±35% | multi-process + a fresh cluster boot per side (cold worker pools) |",
         "| single_client_put_gigabytes | ±45% | ±30% | store page-fault state (cold ~2.1 vs steady 6.7 GiB/s) |",
         "| wait_1k_refs | ±45% | ±30% | timer batching across the submit window |",
         "| serve_llm_* | ±45% | ±30% | multi-second numpy run: allocator/GC state; p99 row is LOWER-is-better (gate inverts) |",

@@ -32,6 +32,38 @@ _FLAGS: Dict[str, Any] = {
     # memory_monitor_refresh_ms to 0 to disable.
     "memory_usage_threshold": 0.95,
     "memory_monitor_refresh_ms": 250,
+    # --- control-plane parallelism (stability contract) ---------------------
+    # Operators size the control plane with these (README "Scaling the
+    # control plane"); renaming any is a breaking change — add new flags
+    # instead.
+    #   rpc_reactor_shards     event-loop shards per RpcServer: accepted
+    #                          connections round-robin across N loops
+    #                          (shard 0 = the server's home loop, handlers
+    #                          hop home unless marked shard-safe — see the
+    #                          rpc.py module docstring). 0 = auto
+    #                          (min(4, cpus)); 1 = the classic single-loop
+    #                          reactor (what any 1-core box resolves to)
+    #   submit_ring_slots      per-submitter plasma-backed submit ring
+    #                          capacity in budgeted entries (~1 KiB each):
+    #                          eligible tiny-task specs are memcpy'd into
+    #                          shared memory and the raylet drains them in
+    #                          batches, leaving one doorbell RPC per
+    #                          empty→non-empty transition on the hot path.
+    #                          0 disables (every submit rides RPC); a full
+    #                          or dead ring always falls back to RPC
+    #   submit_ring_dead_s     consumer-heartbeat staleness after which a
+    #                          producer declares the raylet-side drain dead
+    #                          and resubmits pending ring specs via RPC
+    #   lease_starvation_passes  batched lease-grant passes a queued lease
+    #                          request may be skipped (smaller later
+    #                          requests fitting first) before it becomes a
+    #                          FIFO barrier that later overlapping requests
+    #                          cannot leapfrog — bounds large-request
+    #                          starvation under a stream of small leases
+    "rpc_reactor_shards": 0,
+    "submit_ring_slots": 128,
+    "submit_ring_dead_s": 5.0,
+    "lease_starvation_passes": 32,
     # --- scheduling --------------------------------------------------------
     # Hybrid policy: pack onto nodes until utilization crosses this, then spread.
     "scheduler_spread_threshold": 0.5,

@@ -58,6 +58,10 @@ _METRIC_BANDS: Dict[str, Dict[int, float]] = {
     # scheduler jitter dominates the measurement
     "multi_client_tasks_async": {1: 0.50, 3: 0.35},
     "n_n_actor_calls_async": {1: 0.50, 3: 0.35},
+    # submit-storm A/B pair (ring vs RPC): multi-process like the rows
+    # above, plus each side boots its own cluster (cold worker pools)
+    "many_drivers_submit_storm": {1: 0.50, 3: 0.35},
+    "many_drivers_submit_storm_rpc": {1: 0.50, 3: 0.35},
     # bandwidth depends on store page-fault state (cold first-touch pages
     # vs recycled ones differ ~3x; reps amortize but don't remove it)
     "single_client_put_gigabytes": {1: 0.45, 3: 0.30},
@@ -265,6 +269,25 @@ def _git_head() -> str:
 # -------------------------------------------------------------- measurement
 
 
+def load_result_entry(source) -> Dict[str, Any]:
+    """Like load_result, plus measurement metadata: returns
+    ``{"metrics", "reps", "cpus"}`` where ``cpus`` is the measuring host's
+    core count (None for formats that predate ``host.cpus``). Core counts
+    matter because the multi-process rows scale with them — comparing a
+    1-core measurement against a multi-core one gates the runner, not the
+    code (see cmd_perf's annotation / --skip-noisy handling)."""
+    meta = None
+    if isinstance(source, str):
+        with open(source) as f:
+            source = json.loads(f.read().strip().splitlines()[-1])
+    if isinstance(source, dict):
+        host = source.get("host")
+        if isinstance(host, dict):
+            meta = host.get("cpus")
+    metrics, reps = load_result(source)
+    return {"metrics": metrics, "reps": reps, "cpus": meta}
+
+
 def load_result(source) -> Tuple[Dict[str, float], int]:
     """(metrics, reps) from any of the shapes the plane produces:
 
@@ -337,6 +360,14 @@ def check(only: Optional[str] = None, quick: bool = True,
     if base:
         report["baseline_time"] = base.get("iso") or base.get("time")
         report["baseline_git"] = base.get("git", "")
+        base_cpus = (base.get("host") or {}).get("cpus")
+        cur_cpus = (result.get("host") or {}).get("cpus")
+        if base_cpus and cur_cpus and base_cpus != cur_cpus:
+            # cross-core-count comparison: the multi-process rows scale
+            # with the core count, so this gates the runner, not the code
+            # (cmd_perf demotes regressions to advisory)
+            report["host_mismatch"] = {"baseline_cpus": base_cpus,
+                                       "current_cpus": cur_cpus}
     _publish_gate_metrics(report)
     if update:
         append_history(metrics, path=history, reps=reps, quick=quick,

@@ -25,6 +25,7 @@ import logging
 import os
 import sys
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
@@ -91,8 +92,18 @@ class NodeManager:
         # lease_id -> {"worker_id", "resources": ResourceSet, "bundle": key|None}
         self.leases: Dict[bytes, dict] = {}
         self._lease_seq = 0
-        # queued lease requests waiting for local resources
+        # queued lease requests waiting for local resources, FIFO. Releases
+        # coalesce into one _lease_grant_pass per loop tick (no per-release
+        # thundering herd); a waiter skipped lease_starvation_passes times
+        # becomes a barrier later overlapping requests cannot leapfrog.
         self._lease_waiters: List[dict] = []
+        self._lease_pass_scheduled = False
+        self._starve_limit = max(1, RTPU_CONFIG.lease_starvation_passes)
+        # plasma-backed submit rings (one per attached submitter):
+        # ring object id -> {consumer, backlog, idle leases, ...}
+        self._rings: Dict[bytes, dict] = {}
+        self._ring_event: Optional[asyncio.Event] = None
+        self._ring_task = None
         # (pg_id, bundle_index) -> {"reserved": ResourceSet, "available": ResourceSet,
         #                            "committed": bool}
         self.bundles: Dict[Tuple[bytes, int], dict] = {}
@@ -106,6 +117,13 @@ class NodeManager:
         # object pulls in flight: object_id bytes -> asyncio.Event
         self._pulls: Dict[bytes, asyncio.Event] = {}
         self._recv: Dict[bytes, dict] = {}  # inbound pushes mid-transfer
+        # Explicit guard for the _recv landing counters: chunk sinks run on
+        # reactor shard threads (ReceiveChunk is shard-safe) while aborts
+        # run on the home loop — the counter read-modify-writes must not
+        # rely on single-loop serialization anymore.
+        import threading as _threading
+
+        self._recv_lock = _threading.Lock()
         self._venv_locks: Dict[str, asyncio.Lock] = {}
         self._venv_jobs: Dict[str, set] = {}  # venv hash -> jobs using it
         # pinned primary copies: object_id bytes -> memoryview
@@ -151,6 +169,14 @@ class NodeManager:
         # pre-created plasma buffer at their offset (zero intermediate
         # buffering) — see _receive_chunk_sink.
         self.server.set_oob_sink("ReceiveChunk", self._receive_chunk_sink)
+        # Sharded-reactor dispatch contract (rpc.py docstring): handlers
+        # default to the home loop so the lease/bundle/lifecycle state
+        # above keeps its single-threaded invariants; only the bulk
+        # data-plane methods — whose state is either read-only here or
+        # guarded by the plasma store's native in-segment mutex and the
+        # _recv landing counters — run directly on a connection's shard.
+        self.server.set_shard_safe(
+            {"Ping", "ReceiveChunk", "FetchChunk", "FetchObjectInfo"})
         port = await self.server.start(port)
         self.port = port
         self.worker_pool = WorkerPool(
@@ -389,8 +415,9 @@ class NodeManager:
         self.cluster_view = new_view
         if grew:
             # New capacity (e.g. autoscaler launch): re-evaluate queued
-            # lease requests so they can spill to it.
-            self._kick_waiters()
+            # lease requests so they can spill to it (full wake — waiters
+            # must re-run spill logic, not just retry a local acquire).
+            self._kick_waiters(wake_all=True)
 
     async def _cluster_view_loop(self):
         """Push-based cluster view (reference: RaySyncer resource broadcast,
@@ -743,12 +770,96 @@ class NodeManager:
             self.available.release(lease["grant"]["demand"])
         self._resources_dirty = True
         self._kick_waiters()
+        if self._rings and self._ring_event is not None:
+            # freed capacity may unblock a ring backlog
+            self._ring_event.set()
 
-    def _kick_waiters(self):
-        if self._lease_waiters:
+    def _kick_waiters(self, wake_all: bool = False):
+        """Lease-grant batching: resource releases coalesce into ONE FIFO
+        scheduling pass per loop tick (K concurrent drivers' releases cost
+        one pass over the queue, not K thundering-herd wakeups that each
+        re-run the whole feasibility check). ``wake_all`` keeps the legacy
+        wake-everything behavior for topology changes — a new node or a
+        returned/removed bundle — where waiters must re-run their full
+        spill/PG logic, not just retry a local acquire."""
+        if not self._lease_waiters:
+            return
+        if wake_all:
             waiters, self._lease_waiters = self._lease_waiters, []
             for w in waiters:
                 w["event"].set()
+            return
+        if not self._lease_pass_scheduled:
+            self._lease_pass_scheduled = True
+            asyncio.get_running_loop().call_soon(self._lease_grant_pass)
+
+    def _lease_grant_pass(self):
+        """One batched scheduling pass over ``_lease_waiters`` in FIFO
+        order: acquire resources for every waiter that now fits and wake
+        only those. Fairness: a waiter skipped ``lease_starvation_passes``
+        times becomes a barrier — no later waiter with overlapping demand
+        may leapfrog it, so a large request can't be starved indefinitely
+        by a stream of small ones that fit first."""
+        self._lease_pass_scheduled = False
+        waiters = self._lease_waiters
+        if not waiters:
+            return
+        remaining: List[dict] = []
+        barriers: List[dict] = []
+        for w in waiters:
+            if w["event"].is_set():
+                continue  # woken elsewhere; handler will clean up
+            if any(self._demands_overlap(b, w) for b in barriers):
+                remaining.append(w)
+                continue
+            grant = self._try_acquire(w["res"], w["strat"])
+            if grant is not None:
+                w["grant"] = grant
+                w["event"].set()
+                continue
+            w["skips"] += 1
+            if w["skips"] >= self._starve_limit:
+                barriers.append(w)
+            remaining.append(w)
+        self._lease_waiters = remaining
+
+    @staticmethod
+    def _demands_overlap(a: dict, b: dict) -> bool:
+        """Do two queued lease demands draw from the same pool/resources?
+        (the unit of the starvation barrier)"""
+        a_pg = a["strat"].get("type") == "placement_group"
+        b_pg = b["strat"].get("type") == "placement_group"
+        if a_pg != b_pg:
+            return False
+        if a_pg:
+            return (a["strat"]["pg_id"], a["strat"].get("bundle_index") or 0) \
+                == (b["strat"]["pg_id"], b["strat"].get("bundle_index") or 0)
+        return any(v > 0 and b["res"].get(k, 0) > 0
+                   for k, v in a["res"].items())
+
+    def _blocked_by_starving(self, resources: Dict[str, float],
+                             strategy: dict) -> bool:
+        """Fresh lease requests must not leapfrog a starving queued waiter
+        with overlapping demand — they queue behind it instead."""
+        if not self._lease_waiters:
+            return False
+        probe = {"res": resources, "strat": strategy}
+        return any(w["skips"] >= self._starve_limit
+                   and self._demands_overlap(w, probe)
+                   for w in self._lease_waiters)
+
+    def _waiter_abandon(self, waiter: dict):
+        """A timed-out waiter leaves the queue; a grant that raced the
+        timeout is returned to its pool (the client is about to retry)."""
+        if waiter in self._lease_waiters:
+            self._lease_waiters.remove(waiter)
+        grant = waiter.pop("grant", None)
+        if grant is not None:
+            pool, _ = self._pool_for(waiter["strat"])
+            if pool is not None:
+                pool.release(grant["demand"])
+            self._resources_dirty = True
+            self._kick_waiters()
 
     def _local_feasible(self, resources: Dict[str, float], strategy: dict) -> bool:
         if strategy.get("type") == "placement_group":
@@ -888,10 +999,20 @@ class NodeManager:
         except Exception as e:
             return {"error": f"runtime_env setup failed: {e}"}
 
+        waiter = None
         while True:
             if is_pg and pg_key not in self.bundles:
                 return {"error": "placement group removed"}
-            grant = self._try_acquire(resources, strategy)
+            grant = None
+            if waiter is not None:
+                # woken by the batched grant pass: it may have acquired on
+                # our behalf (FIFO, starvation-bounded); a grant-less wake
+                # (topology change) re-runs the full logic below
+                grant = waiter.pop("grant", None)
+                waiter = None
+            if grant is None and not self._blocked_by_starving(resources,
+                                                               strategy):
+                grant = self._try_acquire(resources, strategy)
             if grant is not None:
                 chips = self._allocate_chips(resources.get("TPU", 0))
                 worker_env = dict(env_overrides or {})
@@ -991,20 +1112,21 @@ class NodeManager:
             # PG-bound tasks are excluded: their bundle is already placed,
             # so a new node could never serve them — reporting them would
             # trigger pointless slice launches.
-            waiter = {"event": asyncio.Event()}
+            new_waiter = {"event": asyncio.Event(), "res": dict(resources),
+                          "strat": strategy, "skips": 0}
             if not is_pg:
-                waiter["resources"] = dict(resources)
-            self._lease_waiters.append(waiter)
+                new_waiter["resources"] = dict(resources)
+            self._lease_waiters.append(new_waiter)
             timeout = deadline - time.time()
             if timeout <= 0:
-                self._lease_waiters.remove(waiter)
+                self._waiter_abandon(new_waiter)
                 return {"retry": True}
             try:
-                await asyncio.wait_for(waiter["event"].wait(), timeout)
+                await asyncio.wait_for(new_waiter["event"].wait(), timeout)
             except asyncio.TimeoutError:
-                if waiter in self._lease_waiters:
-                    self._lease_waiters.remove(waiter)
+                self._waiter_abandon(new_waiter)
                 return {"retry": True}
+            waiter = new_waiter
 
     async def handle_ReturnWorker(self, req):
         lease = self.leases.get(req["lease_id"])
@@ -1017,6 +1139,219 @@ class NodeManager:
                 else:
                     self.worker_pool.push_idle(handle)
         return {"ok": True}
+
+    # ---------------------------------------------- plasma-backed submit ring
+    # (_private/submit_ring.py) A submitter memcpys serialized tiny-task
+    # specs into a shared-memory ring; this raylet drains batches per loop
+    # tick and dispatches them onto its own locally-leased workers, sending
+    # replies back as ONE batched notify per push batch. The only hot-path
+    # RPC left is the submitter's doorbell on empty→non-empty transitions.
+
+    async def handle_AttachSubmitRing(self, req):
+        from ray_tpu._private.submit_ring import RingConsumer
+
+        oid = req["object_id"]
+        old = self._rings.pop(oid, None)
+        if old is not None:
+            self._detach_ring_state(old)
+        view = self.plasma.get(oid)
+        if view is None:
+            return {"ok": False, "error": "ring object not in plasma"}
+        try:
+            consumer = RingConsumer(view)
+        except Exception as e:
+            try:
+                view.release()
+            except Exception:
+                pass
+            self.plasma.release(oid)
+            return {"ok": False, "error": f"bad ring: {e}"}
+        self._rings[oid] = {
+            "oid": oid,
+            "view": view,
+            "consumer": consumer,
+            "reply_addr": tuple(req["reply_addr"]),
+            "job_id": req["job_id"],
+            "backlog": deque(),
+            "runners": 0,
+        }
+        if self._ring_event is None:
+            self._ring_event = asyncio.Event()
+        if self._ring_task is None:
+            self._ring_task = asyncio.ensure_future(self._submit_ring_loop())
+            self._bg.append(self._ring_task)
+        self._ring_event.set()
+        return {"ok": True}
+
+    async def handle_SubmitRingDoorbell(self, req):
+        if self._ring_event is not None:
+            self._ring_event.set()
+        return {"ok": True}
+
+    async def handle_DetachSubmitRing(self, req):
+        ring = self._rings.pop(req["object_id"], None)
+        if ring is not None:
+            self._detach_ring_state(ring)
+        return {"ok": True}
+
+    def _detach_ring_state(self, ring: dict):
+        try:
+            ring["view"].release()
+        except Exception:
+            pass
+        self.plasma.release(ring["oid"])
+        self.plasma.delete(ring["oid"])
+
+    async def _submit_ring_loop(self):
+        """Drain every attached ring per tick. The doorbell notify wakes
+        the loop on empty→non-empty transitions; the short timeout is only
+        a lost-doorbell safety net and the consumer-heartbeat cadence."""
+        while True:
+            try:
+                await asyncio.wait_for(self._ring_event.wait(), 0.2)
+            except asyncio.TimeoutError:
+                pass
+            except asyncio.CancelledError:
+                return
+            self._ring_event.clear()
+            now = time.time()
+            for oid, ring in list(self._rings.items()):
+                try:
+                    self._ring_tick(oid, ring, now)
+                except Exception:
+                    logger.exception("submit ring tick failed; detaching")
+                    self._rings.pop(oid, None)
+                    self._detach_ring_state(ring)
+
+    def _ring_tick(self, oid: bytes, ring: dict, now: float):
+        c = ring["consumer"]
+        c.beat(now)  # producers treat a stale beat as a dead consumer
+        drained = 0
+        while drained < 4096:
+            entries = c.drain(max_items=256)
+            if not entries:
+                break
+            drained += len(entries)
+            for raw in entries:
+                try:
+                    spec = msgpack.unpackb(raw, raw=False,
+                                           strict_map_key=False)
+                except Exception:
+                    logger.exception("undecodable submit-ring entry")
+                    continue
+                ring["backlog"].append(spec)
+        if not c.empty():
+            self._ring_event.set()  # more arrived mid-drain: next tick now
+        if ring["backlog"]:
+            self._ring_pump(ring)
+        elif ring["runners"] == 0 and c.closed():
+            # clean producer detach: reclaim the ring object
+            self._rings.pop(oid, None)
+            self._detach_ring_state(ring)
+
+    def _ring_pump(self, ring: dict):
+        """One runner per grantable backlog task (mirroring the driver's
+        one-lease-request-per-queued-task pumping, so blocking tasks keep
+        real concurrency); each runner is HANDED its first spec here so a
+        bounce can never strand a spawned runner without work. When local
+        resources run out, the leftover backlog bounces back to the
+        submitter if a peer has free capacity (the RPC path knows how to
+        spill); otherwise it queues here until a release re-kicks us."""
+        while ring["backlog"]:
+            spec0 = ring["backlog"][0]
+            resources = dict(spec0.get("resources") or {})
+            grant = self._try_acquire(resources, {})
+            if grant is None:
+                if self.cluster_view and self._pick_spill_node(
+                        resources, {}, require_available=True) is not None:
+                    bounced = list(ring["backlog"])
+                    ring["backlog"].clear()
+                    self._ring_post_replies(ring, [
+                        (s["task_id"], {"ring_bounce": True})
+                        for s in bounced])
+                break
+            first = ring["backlog"].popleft()
+            ring["runners"] += 1
+            asyncio.ensure_future(self._ring_spawn(ring, grant, first))
+
+    async def _ring_spawn(self, ring: dict, grant: dict, first: dict):
+        try:
+            handle = await self.worker_pool.pop_worker(ring["job_id"], None)
+        except Exception:
+            logger.exception("ring worker spawn failed")
+            handle = None
+        if handle is None:
+            self.available.release(grant["demand"])
+            self._resources_dirty = True
+            ring["runners"] -= 1
+            self._ring_post_replies(ring, [
+                (first["task_id"],
+                 {"status": "error", "worker_crashed": True,
+                  "error": "ring worker startup failed"})])
+            return
+        self._lease_seq += 1
+        lease_id = self._lease_seq.to_bytes(8, "little") + os.urandom(4)
+        handle.lease_id = lease_id
+        self.leases[lease_id] = {
+            "worker_id": handle.worker_id,
+            "grant": grant,
+            "bundle": None,
+            "chips": None,
+            "t": time.time(),
+        }
+        _fr.record("lease.grant", lease_id, handle.worker_id.hex()[:12])
+        await self._ring_runner(ring, handle, lease_id, first)
+
+    async def _ring_runner(self, ring: dict, handle, lease_id: bytes,
+                           first: dict):
+        """Run the handed spec, then keep draining backlog batches on this
+        lease until nothing is left; release the lease immediately after
+        (holding it idle would starve every other lease waiter) while the
+        warm worker returns to the pool for the next pump."""
+        push_batch = RTPU_CONFIG.task_push_max_batch
+        batch = [first]
+        try:
+            while batch:
+                try:
+                    client = await self.pool.get(*handle.addr)
+                    r = await client.call("PushTasks", {"specs": batch},
+                                          timeout=None)
+                    replies = r["replies"]
+                except Exception as e:
+                    # worker died mid-batch: the submitter retries through
+                    # its ordinary worker-crash path (lease cleanup rides
+                    # _on_worker_death)
+                    self._ring_post_replies(ring, [
+                        (s["task_id"],
+                         {"status": "error", "worker_crashed": True,
+                          "error": f"ring worker died: "
+                                   f"{type(e).__name__}: {e}"})
+                        for s in batch])
+                    return
+                self._ring_post_replies(
+                    ring, [(s["task_id"], rep)
+                           for s, rep in zip(batch, replies)])
+                batch = []
+                while ring["backlog"] and len(batch) < push_batch:
+                    batch.append(ring["backlog"].popleft())
+            if lease_id in self.leases:
+                self._release_lease(lease_id)
+                if handle.alive:
+                    self.worker_pool.push_idle(handle)
+        finally:
+            ring["runners"] -= 1
+
+    def _ring_post_replies(self, ring: dict, replies):
+        payload = {"replies": [[tid, rep] for tid, rep in replies]}
+
+        async def _send():
+            try:
+                client = await self.pool.get(*ring["reply_addr"])
+                await client.notify("SubmitRingReplies", payload)
+            except Exception:
+                _fr.record("rpc.error", b"", "SubmitRingReplies dropped")
+
+        asyncio.ensure_future(_send())
 
     async def handle_GetNodeInfo(self, req):
         return {
@@ -1429,6 +1764,11 @@ class NodeManager:
         return {"ok": True}
 
     async def handle_JobFinished(self, req):
+        # submit rings of the finished job's drivers/workers are garbage now
+        for oid, ring in list(self._rings.items()):
+            if ring["job_id"] == req["job_id"]:
+                self._rings.pop(oid, None)
+                self._detach_ring_state(ring)
         self.worker_pool.kill_job_workers(req["job_id"])
         # evict pip venvs no job still references (reference: runtime_env
         # agent deletes per-job URIs on job exit)
@@ -1519,7 +1859,8 @@ class NodeManager:
         if bundle is not None:
             self.available.release(bundle["reserved"])
             self._resources_dirty = True
-            self._kick_waiters()
+            # full wake: waiters bound to this PG must observe its removal
+            self._kick_waiters(wake_all=True)
 
     # ----------------------------------------------------- spilling / OOM
 
@@ -2245,12 +2586,14 @@ class NodeManager:
         rec = self._recv.pop(oid, None)
         if rec is None:
             return
-        if rec.get("landing", 0) > 0:
-            # a chunk is streaming into the buffer right now (oob sink) —
-            # defer the plasma abort until the last lander finishes so the
-            # store can't hand this memory to a new object mid-write
-            rec["abort_pending"] = True
-            return
+        with self._recv_lock:
+            if rec.get("landing", 0) > 0:
+                # a chunk is streaming into the buffer right now (oob sink,
+                # possibly on a reactor shard thread) — defer the plasma
+                # abort until the last lander finishes so the store can't
+                # hand this memory to a new object mid-write
+                rec["abort_pending"] = True
+                return
         self._finish_abort_recv(oid, rec)
 
     def _finish_abort_recv(self, oid: bytes, rec: dict):
@@ -2273,13 +2616,16 @@ class NodeManager:
         off = payload.get("offset")
         if not isinstance(off, int) or off < 0 or off + nbytes > rec["size"]:
             return None
-        rec["landing"] = rec.get("landing", 0) + 1
+        with self._recv_lock:
+            rec["landing"] = rec.get("landing", 0) + 1
         rec["t"] = time.time()
 
         def done(ok, oid=payload["object_id"], rec=rec):
-            rec["landing"] -= 1
-            rec["t"] = time.time()
-            if rec.get("abort_pending") and rec["landing"] <= 0:
+            with self._recv_lock:
+                rec["landing"] -= 1
+                rec["t"] = time.time()
+                finish = rec.get("abort_pending") and rec["landing"] <= 0
+            if finish:
                 self._finish_abort_recv(oid, rec)
 
         return rec["view"][off:off + nbytes], done

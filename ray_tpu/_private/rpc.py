@@ -29,19 +29,47 @@ see the landed payload as payload["_oob"]: an int byte-count when a sink /
 oob_dest absorbed it in place, else a bytearray holding the raw bytes.
 Handlers reply out-of-band by returning an OobPayload.
 
+Threading model — the sharded reactor
+--------------------------------------
 Every process owns a single background IO thread running one asyncio loop
 (mirroring the reference's per-process asio io_service,
 reference: src/ray/common/asio/). Synchronous front-end code posts coroutines
-onto it via run_coroutine_threadsafe.
+onto it via run_coroutine_threadsafe. That loop is a server's HOME loop:
+``RpcServer.start()`` records it, and all shared handler state belongs to it.
+
+With ``RTPU_rpc_reactor_shards`` > 1 (default ``min(4, cpus)``; a 1-core box
+degenerates to exactly the old single-loop behavior), the server accepts on
+the home loop but hands each accepted connection to one of N reactor shard
+loops, each running in its own thread (process-global pool, shared by every
+RpcServer in the process). Per-connection work — frame reads, msgpack
+decode/encode, response writes, drain/flow-control, the chaos ``rpc.recv``
+seam, OOB payload landing via the per-method sink, and connection-upgrade
+hooks — runs on the connection's shard, so independent connections stop
+serializing behind one thread.
+
+What is per-shard vs shared:
+  per-shard   frame parse/serialize, socket IO, writer locks, OOB sinks,
+              upgrade hooks, chaos seams (chaos.py is internally locked)
+  shared      registered handlers and the state they close over. By default
+              a handler coroutine HOPS to the home loop
+              (run_coroutine_threadsafe + wrap_future), so raylet/GCS/worker
+              handler state keeps its single-threaded invariants by
+              construction rather than by accident. Methods whose handlers
+              are thread-safe (pure reads, natively-locked plasma ops) can
+              opt into running directly on the shard via
+              ``set_shard_safe({...})`` — the raylet marks its bulk
+              data-plane methods (ReceiveChunk/FetchChunk/...) this way.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
+import socket as _socket_mod
 import struct
 import threading
 import traceback
-from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
 
 import cloudpickle
 import msgpack
@@ -153,6 +181,46 @@ class OobPayload:
                 pass
 
 
+# ------------------------------------------------------- reactor shard pool
+# Process-global pool of extra event-loop threads serving accepted
+# connections (shard 0 is always the server's home loop, so the pool holds
+# shards 1..N-1). Grown lazily, shared by every RpcServer in the process.
+
+_shard_lock = threading.Lock()
+_shard_loops: List[asyncio.AbstractEventLoop] = []
+
+
+def _shard_loop(index: int) -> asyncio.AbstractEventLoop:
+    with _shard_lock:
+        while len(_shard_loops) <= index:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=_run_shard, args=(loop,),
+                name=f"rtpu-rpc-shard-{len(_shard_loops) + 1}", daemon=True)
+            t.start()
+            _shard_loops.append(loop)
+        return _shard_loops[index]
+
+
+def _run_shard(loop: asyncio.AbstractEventLoop):
+    asyncio.set_event_loop(loop)
+    loop.run_forever()
+
+
+def resolve_reactor_shards(requested: Optional[int] = None) -> int:
+    """Shard count: explicit arg > RTPU_rpc_reactor_shards > min(4, cpus).
+    1 (any 1-core box) means the classic single-loop reactor."""
+    n = requested
+    if n is None:
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        n = RTPU_CONFIG.rpc_reactor_shards
+    n = int(n or 0)
+    if n <= 0:
+        n = min(4, os.cpu_count() or 1)
+    return max(1, n)
+
+
 Handler = Callable[[Any], Awaitable[Any]]
 
 # Per-method receive sink: sink(payload, nbytes) -> None | (dest_view, done).
@@ -165,15 +233,30 @@ OobSink = Callable[[Any, int], Optional[Tuple[memoryview, Optional[Callable]]]]
 class RpcServer:
     """Serves registered async handlers; one instance per process role."""
 
-    def __init__(self, host: str = "127.0.0.1"):
+    def __init__(self, host: str = "127.0.0.1", shards: Optional[int] = None):
         self._host = host
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
-        self._conns: set = set()
+        # writer -> owning event loop (close must happen on that loop)
+        self._conns: Dict[Any, asyncio.AbstractEventLoop] = {}
         self._validator = None
         self._upgrades: Dict[str, Any] = {}
         self._oob_sinks: Dict[str, OobSink] = {}
+        # sharded reactor state (module docstring "Threading model")
+        self._shards_requested = shards
+        self.num_shards = 1
+        self._home_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._lsock = None
+        self._accept_task = None
+        self._next_shard = 0
+        self._shard_safe: set = set()
+
+    def set_shard_safe(self, methods):
+        """Mark methods whose handlers may run directly on a connection's
+        shard loop (thread-safe by construction: pure reads or
+        natively-locked state). Everything else hops to the home loop."""
+        self._shard_safe.update(methods)
 
     def set_oob_sink(self, method: str, sink: OobSink):
         """Register a landing sink for MSG_REQUEST_OOB frames of `method`:
@@ -207,27 +290,83 @@ class RpcServer:
                 self.register(prefix + attr[len("handle_") :], getattr(obj, attr))
 
     async def start(self, port: int = 0) -> int:
-        self._server = await asyncio.start_server(
-            self._on_connection, self._host, port, limit=_MAX_FRAME
-        )
-        self.port = self._server.sockets[0].getsockname()[1]
+        self._home_loop = asyncio.get_running_loop()
+        self.num_shards = resolve_reactor_shards(self._shards_requested)
+        if self.num_shards <= 1:
+            self._server = await asyncio.start_server(
+                self._on_connection, self._host, port, limit=_MAX_FRAME
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            return self.port
+        sock = _socket_mod.socket(_socket_mod.AF_INET, _socket_mod.SOCK_STREAM)
+        sock.setsockopt(_socket_mod.SOL_SOCKET, _socket_mod.SO_REUSEADDR, 1)
+        sock.bind((self._host, port))
+        sock.listen(256)
+        sock.setblocking(False)
+        self._lsock = sock
+        self.port = sock.getsockname()[1]
+        self._accept_task = asyncio.ensure_future(self._accept_loop())
         return self.port
 
+    async def _accept_loop(self):
+        """Accept on the home loop, serve each connection on a shard loop
+        picked round-robin (shard 0 IS the home loop, so a 1-shard server
+        never crosses threads)."""
+        loop = self._home_loop
+        while True:
+            try:
+                conn, _addr = await loop.sock_accept(self._lsock)
+            except (asyncio.CancelledError, OSError):
+                return
+            conn.setblocking(False)
+            shard = self._next_shard % self.num_shards
+            self._next_shard += 1
+            if shard == 0:
+                asyncio.ensure_future(self._serve_conn(conn))
+            else:
+                asyncio.run_coroutine_threadsafe(
+                    self._serve_conn(conn), _shard_loop(shard - 1))
+
+    async def _serve_conn(self, sock):
+        try:
+            reader, writer = await asyncio.open_connection(
+                sock=sock, limit=_MAX_FRAME)
+        except Exception:
+            try:
+                sock.close()
+            except Exception:
+                pass
+            return
+        await self._on_connection(reader, writer)
+
     async def stop(self):
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            self._accept_task = None
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except Exception:
+                pass
+            self._lsock = None
         if self._server is not None:
             self._server.close()
             try:
                 await self._server.wait_closed()
             except Exception:
                 pass
-        for w in list(self._conns):
+        here = asyncio.get_running_loop()
+        for w, loop in list(self._conns.items()):
             try:
-                w.close()
+                if loop is here:
+                    w.close()
+                else:
+                    loop.call_soon_threadsafe(w.close)
             except Exception:
                 pass
 
     async def _on_connection(self, reader, writer):
-        self._conns.add(writer)
+        self._conns[writer] = asyncio.get_running_loop()
         lock = asyncio.Lock()
         try:
             while True:
@@ -256,23 +395,15 @@ class RpcServer:
                         sock = writer.get_extra_info("socket")
                         dup = sock.dup()
                         dup.setblocking(True)
-                        self._conns.discard(writer)
+                        self._conns.pop(writer, None)
                         writer.transport.pause_reading()
                         # drain() only waits for the buffer to fall below
                         # the high-water mark; abort() discards whatever is
                         # still buffered. Under a full socket buffer that
                         # loses the upgrade response and costs the client a
-                        # timeout + backoff — wait for a true flush first.
-                        deadline = asyncio.get_running_loop().time() + 5.0
-                        while True:
-                            try:
-                                if writer.transport.get_write_buffer_size() == 0:
-                                    break
-                            except Exception:
-                                break
-                            if asyncio.get_running_loop().time() > deadline:
-                                break
-                            await asyncio.sleep(0.005)
+                        # timeout + backoff — wait for a true flush first
+                        # via the transport's own flow-control signal.
+                        await self._flush_transport(writer)
                         # Closes the transport's fd only; the dup keeps the
                         # TCP connection alive for the adopting thread.
                         writer.transport.abort()
@@ -301,13 +432,30 @@ class RpcServer:
                 elif mtype == MSG_NOTIFY:
                     handler = self._handlers.get(method)
                     if handler is not None:
-                        asyncio.ensure_future(self._run_notify(handler, payload))
+                        asyncio.ensure_future(
+                            self._run_notify(method, handler, payload))
         finally:
-            self._conns.discard(writer)
+            self._conns.pop(writer, None)
             try:
                 writer.close()
             except Exception:
                 pass
+
+    @staticmethod
+    async def _flush_transport(writer, timeout: float = 5.0):
+        """Wait for a TRUE transport flush (buffer empty, not merely below
+        the high-water mark): shrink the flow-control window to zero so
+        ``drain()`` returns only once the kernel accepted every buffered
+        byte. This is the transport's own resume_writing signal — no
+        polling loop."""
+        t = writer.transport
+        try:
+            if t.get_write_buffer_size() == 0:
+                return
+            t.set_write_buffer_limits(high=0, low=0)
+            await asyncio.wait_for(writer.drain(), timeout)
+        except Exception:
+            pass
 
     async def _land_oob(self, reader, method, payload, nbytes: int):
         """Consume an OOB request's raw payload. The method's sink, when
@@ -352,9 +500,27 @@ class RpcServer:
         await asyncio.sleep(delay_s)
         await self._dispatch(writer, lock, seq, method, payload)
 
-    async def _run_notify(self, handler, payload):
+    async def _run_handler(self, method: str, handler, payload):
+        """Run a handler with the home-loop dispatch contract: on the home
+        loop (or for shard-safe methods) call it in place; from a shard
+        loop, hop — the coroutine executes on the home loop and the shard
+        awaits its result, so shared handler state never sees two threads.
+        The response is packed and written back on the shard."""
+        loop = asyncio.get_running_loop()
+        if loop is self._home_loop or method in self._shard_safe \
+                or self._home_loop is None:
+            return await handler(payload)
+        cf = asyncio.run_coroutine_threadsafe(handler(payload),
+                                              self._home_loop)
         try:
-            await handler(payload)
+            return await asyncio.wrap_future(cf)
+        except asyncio.CancelledError:
+            cf.cancel()
+            raise
+
+    async def _run_notify(self, method, handler, payload):
+        try:
+            await self._run_handler(method, handler, payload)
         except Exception:
             traceback.print_exc()
 
@@ -365,7 +531,7 @@ class RpcServer:
                 raise RpcError(f"no such method: {method}")
             if self._validator is not None:
                 self._validator(method, payload)
-            result = await handler(payload)
+            result = await self._run_handler(method, handler, payload)
             if isinstance(result, OobPayload):
                 await self._reply_oob(writer, lock, seq, result)
                 return

@@ -155,6 +155,12 @@ RAYLET_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     # sweep=True forces a leak sweep before replying (CLI --leaks path)
     "GetMemoryReport": {"include_workers?": bool, "limit?": int,
                         "sweep?": bool},
+    # plasma-backed submit ring (_private/submit_ring.py): attach/detach a
+    # shared-memory spec mailbox; the doorbell is the only hot-path RPC
+    "AttachSubmitRing": {"object_id": bytes, "reply_addr": _addr,
+                         "job_id": bytes},
+    "DetachSubmitRing": {"object_id": bytes},
+    "SubmitRingDoorbell": {"object_id?": (bytes, type(None))},
     "Ping": {},
 }
 
@@ -165,6 +171,8 @@ WORKER_SCHEMAS: Dict[str, Dict[str, TypeSpec]] = {
     "PushActorTask": {"spec": dict},
     "PushActorTasks": {"specs": list, "reply_addr": _addr},
     "ActorTaskReplies": {"replies": list},
+    # batched replies for ring-submitted specs (raylet -> submitter)
+    "SubmitRingReplies": {"replies": list},
     "GetObjectStatus": {"object_id": bytes, "wait?": bool,
                         "timeout?": (_num, type(None))},
     "AddBorrowerRef": {"object_id": bytes, "borrower": _addr},

@@ -23,6 +23,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+import msgpack
+
 from ray_tpu._native.plasma import PlasmaClient, PlasmaOOM
 from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import flight_recorder as _fr
@@ -304,6 +306,20 @@ class CoreWorker:
         self.raylet: Optional[RpcClient] = None
         self._raylet_addr = raylet_addr
         self._startup_token = startup_token
+
+        # plasma-backed submit ring (_private/submit_ring.py): eligible
+        # tiny-task specs bypass the RPC submit path via shared memory.
+        # All state IO-loop only; the ring is attached lazily on the first
+        # eligible submit and every failure falls back to RPC.
+        self._ring = None
+        self._ring_oid: Optional[bytes] = None
+        self._ring_dead = False
+        self._ring_attach_state = 0  # 0 = never tried, 1 = tried/attaching
+        self._ring_attach_t = 0.0
+        self._ring_pending: Dict[bytes, dict] = {}  # task_id -> spec
+        self._ring_submitted = 0  # counter for tests/introspection
+        self._cfg_ring_slots = RTPU_CONFIG.submit_ring_slots
+        self._cfg_ring_dead_s = RTPU_CONFIG.submit_ring_dead_s
 
         # ownership / submission state (IO-loop only)
         self._leases: Dict[tuple, _LeaseState] = {}
@@ -654,6 +670,8 @@ class CoreWorker:
                 if blocker is not None:
                     self._arg_waiting.setdefault(blocker, []).append(item)
                     continue
+                if self._ring_submit(item):
+                    continue  # rode the shared-memory submit ring
                 key = ts.scheduling_key(item)
                 state = self._leases.setdefault(key, _LeaseState())
                 state.queue.append(item)
@@ -716,6 +734,8 @@ class CoreWorker:
             blocker = self._unready_owned_arg(spec)
             if blocker is not None:
                 self._arg_waiting.setdefault(blocker, []).append(spec)
+                continue
+            if self._ring_submit(spec):
                 continue
             key = ts.scheduling_key(spec)
             state = self._leases.setdefault(key, _LeaseState())
@@ -1446,10 +1466,172 @@ class CoreWorker:
         return out
 
     async def _submit_normal(self, spec: dict):
+        if self._ring_submit(spec):
+            return
+        await self._submit_via_rpc(spec)
+
+    async def _submit_via_rpc(self, spec: dict):
+        """The classic lease-and-push submit path (also the explicit
+        fallback for specs the submit ring bounced back)."""
         key = ts.scheduling_key(spec)
         state = self._leases.setdefault(key, _LeaseState())
         state.queue.append(spec)
         await self._pump_leases(key, state)
+
+    # ------------------------------------------- plasma-backed submit ring
+
+    _RING_RESOURCES = {"CPU": 1.0}
+
+    def _ring_eligible(self, spec: dict) -> bool:
+        """The ring is a fast path for the overwhelmingly common tiny-task
+        shape only: default strategy, no runtime_env, exactly the default
+        {CPU: 1} demand (ring leases are reused across specs, so demands
+        must be homogeneous). Everything else rides the RPC path."""
+        return (not spec.get("strategy")
+                and not spec.get("runtime_env")
+                and spec.get("resources") == self._RING_RESOURCES)
+
+    def _ring_submit(self, spec: dict) -> bool:
+        """Try the shared-memory submit path; False means the caller must
+        use the RPC path (ring disabled, full, dead, or spec ineligible)."""
+        if self._ring_dead or self._cfg_ring_slots <= 0 \
+                or not self._ring_eligible(spec):
+            return False
+        if self._ring is None:
+            if self._ring_attach_state == 0 and self.plasma is not None:
+                self._ring_attach_state = 1
+                asyncio.ensure_future(self._attach_submit_ring())
+            return False
+        try:
+            payload = msgpack.packb(spec, use_bin_type=True)
+        except Exception:
+            return False  # unpackable spec (shouldn't happen): RPC path
+        pushed = self._ring.try_push(payload)
+        if pushed is None:
+            return False  # ring full: clean fallback to RPC
+        self._ring_pending[spec["task_id"]] = spec
+        self._ring_submitted += 1
+        self.task_events.record(spec, "SUBMITTED")
+        if pushed:
+            # empty→non-empty transition: the raylet's drain loop is (or is
+            # about to go) asleep — the one RPC left on this path
+            asyncio.ensure_future(self._ring_doorbell())
+        return True
+
+    async def _attach_submit_ring(self):
+        from ray_tpu._private import submit_ring as _sr
+
+        try:
+            # exactly _OBJECT_ID_SIZE (20) bytes: the store reads a fixed
+            # 20-byte key, so a short id would carry undefined tail bytes
+            oid = (b"\xf1RNG" + self.worker_id.binary()).ljust(20, b"\0")[:20]
+            size = _sr.ring_bytes(self._cfg_ring_slots)
+            try:
+                view = self.plasma.create(oid, size)
+            except FileExistsError:
+                self.plasma.delete(oid)
+                view = self.plasma.create(oid, size)
+            try:
+                _sr.RingProducer(view, init=True)
+            finally:
+                view.release()
+            # seal publishes the region (and drops the creator pin);
+            # re-pin with get() for the producer's lifetime — the mapping
+            # is read-write, the ring is a shared mailbox, not a value
+            self.plasma.seal(oid)
+            pinned = self.plasma.get(oid)
+            if pinned is None:
+                raise RuntimeError("ring object evicted before pin")
+            producer = _sr.RingProducer(pinned)
+            r = await self.raylet.call("AttachSubmitRing", {
+                "object_id": oid,
+                "reply_addr": list(self.address),
+                "job_id": self.job_id.binary(),
+            }, timeout=10)
+            if not r.get("ok"):
+                raise RuntimeError(r.get("error", "attach refused"))
+            self._ring = producer
+            self._ring_oid = oid
+            self._ring_attach_t = time.time()
+            asyncio.ensure_future(self._ring_liveness_loop())
+        except Exception as e:
+            _fr.record("rpc.error", b"", f"submit ring attach failed: {e}")
+            # stay unattached; _ring_attach_state == 1 prevents retries
+
+    async def _ring_doorbell(self):
+        try:
+            await self.raylet.notify(
+                "SubmitRingDoorbell", {"object_id": self._ring_oid})
+        except Exception:
+            self._ring_mark_dead("doorbell failed (raylet connection lost)")
+
+    async def _ring_liveness_loop(self):
+        """Dead-consumer detection: the raylet heartbeats the ring header
+        every drain tick; a stale beat (raylet restarted/wedged) or a lost
+        raylet connection fails pending ring specs over to the RPC path."""
+        while not self._ring_dead and not self.is_shutdown:
+            await asyncio.sleep(1.0)
+            if not self._ring_pending:
+                continue
+            if not self.raylet.is_connected():
+                self._ring_mark_dead("raylet connection lost")
+                return
+            beat = self._ring.consumer_beat()
+            ref = beat if beat else self._ring_attach_t
+            if time.time() - ref > self._cfg_ring_dead_s:
+                self._ring_mark_dead(
+                    f"consumer heartbeat stale (> {self._cfg_ring_dead_s}s)")
+                return
+
+    def _ring_mark_dead(self, reason: str):
+        """The drain side is gone: every not-yet-replied ring spec is
+        resubmitted via RPC. The dead raylet took its undispatched backlog
+        (and the local workers) with it, so this cannot double-execute an
+        undispatched task; a dispatched-but-unreplied one retries under
+        the same at-least-once contract as any worker crash."""
+        if self._ring_dead:
+            return
+        self._ring_dead = True
+        _fr.record("rpc.error", b"", f"submit ring dead: {reason}")
+        pending, self._ring_pending = list(self._ring_pending.values()), {}
+        for spec in pending:
+            asyncio.ensure_future(self._submit_normal(spec))
+
+    def _ring_close(self):
+        """Clean detach at shutdown: flag the header (the raylet reclaims
+        the ring object at its next tick) and drop our pin."""
+        ring, self._ring = self._ring, None
+        if ring is None:
+            return
+        try:
+            ring.close()
+        except Exception:
+            pass
+        try:
+            self.plasma.release(self._ring_oid)
+        except Exception:
+            pass
+
+    async def handle_SubmitRingReplies(self, req):
+        """Batched task replies for ring-submitted specs, forwarded by the
+        raylet (one notify per dispatched push batch)."""
+        for task_id, reply in req["replies"]:
+            spec = self._ring_pending.pop(task_id, None)
+            if spec is None:
+                record = self._pending_tasks.get(task_id)
+                spec = record["spec"] if record else None
+                if spec is None:
+                    continue
+            if reply.get("ring_bounce"):
+                # local node saturated while a peer had room: re-route via
+                # the RPC lease path, which knows how to spill
+                await self._submit_via_rpc(spec)
+            elif reply.get("worker_crashed"):
+                await self._handle_worker_crash(
+                    spec, RuntimeError(reply.get("error",
+                                                 "ring worker died")))
+            else:
+                await self._process_task_reply(spec, reply)
 
     async def _pump_leases(self, key, state: _LeaseState):
         while state.queue and state.idle:
@@ -2566,6 +2748,10 @@ class CoreWorker:
             if self._direct is not None:
                 self._direct.close_all()
             self._direct_server.close_all()
+        except Exception:
+            pass
+        try:
+            self._ring_close()
         except Exception:
             pass
         try:

@@ -677,16 +677,28 @@ def cmd_perf(args):
             # base and head back-to-back instead, so it stays strict).
             report["advisory"] = True
             exit_fail = False
+        if exit_fail and report.get("host_mismatch") and not args.strict:
+            # Baseline and current ran on different core counts: the
+            # multi-process rows measure the box, not the code.
+            report["advisory"] = True
+            exit_fail = False
         if args.as_json:
             print(json.dumps(report))
         else:
             print(pg.render_report(report))
+            if report.get("host_mismatch"):
+                hm = report["host_mismatch"]
+                print(f"warning: baseline measured on "
+                      f"{hm['baseline_cpus']} cpus, this run on "
+                      f"{hm['current_cpus']} — cross-core-count deltas on "
+                      "the multi-process rows track the runner, not the "
+                      "code")
             if report.get("advisory"):
                 print("warning: regression(s) measured on a single-core box "
-                      "are ADVISORY — ambient load is indistinguishable from "
-                      "a code regression here; pass --strict to fail anyway, "
-                      "A/B the suspect metric back-to-back, or re-baseline "
-                      "with --update")
+                      "or across different core counts are ADVISORY — pass "
+                      "--strict to fail anyway, A/B the suspect metric "
+                      "back-to-back on one box, or re-baseline with "
+                      "--update")
         if args.output:
             with open(args.output, "w") as f:
                 json.dump(report, f, indent=2)
@@ -695,18 +707,38 @@ def cmd_perf(args):
         return
 
     if args.perf_cmd == "compare":
+        base = pg.load_result_entry(args.base)
+        head = pg.load_result_entry(args.head)
+        cpus_differ = (base["cpus"] and head["cpus"]
+                       and base["cpus"] != head["cpus"])
         if args.skip_noisy and pg.is_noisy_runner():
             report = {"status": "skipped",
                       "reason": "single-core runner: multi-process metrics "
                                 "measure the OS scheduler, not the framework",
                       "metrics": {}}
             print("perf gate skipped: " + report["reason"])
+        elif args.skip_noisy and cpus_differ:
+            report = {"status": "skipped",
+                      "reason": f"core-count mismatch (base "
+                                f"{base['cpus']} vs head {head['cpus']} "
+                                "cpus): the multi-process rows scale with "
+                                "the core count — this comparison gates "
+                                "the runner, not the code",
+                      "metrics": {}}
+            print("perf gate skipped: " + report["reason"])
         else:
-            base_metrics, base_reps = pg.load_result(args.base)
-            head_metrics, head_reps = pg.load_result(args.head)
-            report = pg.compare(base_metrics, head_metrics,
-                                base_reps=base_reps, cur_reps=head_reps)
+            report = pg.compare(base["metrics"], head["metrics"],
+                                base_reps=base["reps"],
+                                cur_reps=head["reps"])
+            if cpus_differ:
+                report["host_mismatch"] = {"baseline_cpus": base["cpus"],
+                                           "current_cpus": head["cpus"]}
             print(pg.render_report(report))
+            if cpus_differ:
+                print(f"warning: base measured on {base['cpus']} cpus, "
+                      f"head on {head['cpus']} — deltas on the "
+                      "multi-process rows track the runner, not the code "
+                      "(pass --skip-noisy to skip such comparisons)")
         if args.output:
             with open(args.output, "w") as f:
                 json.dump(report, f, indent=2)

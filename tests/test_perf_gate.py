@@ -18,6 +18,7 @@ Contracts under test:
 """
 
 import json
+import os
 
 import pytest
 
@@ -149,6 +150,85 @@ def test_perf_compare_cli_gates_regression(tmp_path, capsys):
     # passing pair exits cleanly
     head.write_text('{"single_client_tasks_sync": 950.0}')
     scripts.main(["perf", "compare", str(base), str(head)])
+
+
+@pytest.mark.fast
+def test_load_result_entry_carries_host_cpus(tmp_path):
+    v1 = tmp_path / "v1.json"
+    v1.write_text(json.dumps({
+        "schema": "microbench.v1", "reps": 3, "host": {"cpus": 8},
+        "metrics": {"m": {"value": 10.0}}}))
+    entry = pg.load_result_entry(str(v1))
+    assert entry["metrics"] == {"m": 10.0}
+    assert entry["reps"] == 3 and entry["cpus"] == 8
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text('{"m": 5.5}\n')
+    entry = pg.load_result_entry(str(legacy))
+    assert entry["cpus"] is None  # predates host.cpus: unknown, not wrong
+
+
+@pytest.mark.fast
+def test_perf_compare_annotates_core_count_mismatch(monkeypatch, tmp_path,
+                                                    capsys):
+    """A 1-core measurement compared against a multi-core one must never
+    silently gate: the report is annotated, and --skip-noisy skips it.
+    (is_noisy_runner is pinned False so the single-core skip path of the
+    box running this test doesn't shadow the mismatch path.)"""
+    from ray_tpu import scripts
+
+    monkeypatch.setattr(pg, "is_noisy_runner", lambda: False)
+    base = tmp_path / "base.json"
+    head = tmp_path / "head.json"
+    base.write_text(json.dumps({
+        "schema": "microbench.v1", "reps": 3, "host": {"cpus": 8},
+        "metrics": {"multi_client_tasks_async": {"value": 20000.0}}}))
+    head.write_text(json.dumps({
+        "schema": "microbench.v1", "reps": 3, "host": {"cpus": 1},
+        "metrics": {"multi_client_tasks_async": {"value": 3000.0}}}))
+    out_file = tmp_path / "delta.json"
+    # annotated (and still gating) without --skip-noisy
+    with pytest.raises(SystemExit) as e:
+        scripts.main(["perf", "compare", str(base), str(head),
+                      "-o", str(out_file)])
+    assert e.value.code == 1
+    report = json.loads(out_file.read_text())
+    assert report["host_mismatch"] == {"baseline_cpus": 8, "current_cpus": 1}
+    assert "cpus" in capsys.readouterr().out
+    # --skip-noisy: cross-core-count comparison skipped cleanly (exit 0)
+    scripts.main(["perf", "compare", str(base), str(head), "--skip-noisy",
+                  "-o", str(out_file)])
+    report = json.loads(out_file.read_text())
+    assert report["status"] == "skipped"
+    assert "core-count mismatch" in report["reason"]
+    # same-core-count comparisons are untouched by the new path
+    head.write_text(json.dumps({
+        "schema": "microbench.v1", "reps": 3, "host": {"cpus": 8},
+        "metrics": {"multi_client_tasks_async": {"value": 19000.0}}}))
+    scripts.main(["perf", "compare", str(base), str(head)])
+
+
+@pytest.mark.fast
+def test_perf_check_advisory_on_host_mismatch(monkeypatch, tmp_path):
+    """`perf check` against a ledger head recorded on a different core
+    count demotes regressions to advisory (the 1-core-CI-vs-multi-core
+    guard), unless --strict."""
+    from ray_tpu import scripts
+
+    hist = tmp_path / "hist.jsonl"
+    entry = {"time": 1.0, "reps": 1, "host": {"cpus": 64},
+             "metrics": {"single_client_tasks_sync": 1_000_000.0}}
+    hist.write_text(json.dumps(entry) + "\n")
+    monkeypatch.setattr(pg, "run_microbench", lambda only=None, quick=True: {
+        "schema": "microbench.v1", "reps": 1,
+        "host": {"cpus": os.cpu_count()},
+        "metrics": {"single_client_tasks_sync": {"value": 10.0}}})
+    monkeypatch.setattr(pg, "is_noisy_runner", lambda: False)
+    # huge drop, but measured on a different box shape: advisory exit 0
+    scripts.main(["perf", "check", "--history", str(hist)])
+    # --strict restores the hard failure
+    with pytest.raises(SystemExit) as e:
+        scripts.main(["perf", "check", "--history", str(hist), "--strict"])
+    assert e.value.code == 1
 
 
 @pytest.mark.fast
