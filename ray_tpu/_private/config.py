@@ -164,6 +164,9 @@ _FLAGS: Dict[str, Any] = {
     #                                for N steps at the next train step;
     #                                no-ops on CPU unless
     #                                RTPU_device_trace_force=1
+    #   device_trace_force           capture device traces even on the
+    #                                CPU backend (tests / chip-free
+    #                                debugging of the trace plumbing)
     "profile_slow_step_factor": 3.0,
     "profile_slow_step_cooldown_s": 600.0,
     "profile_trigger_duration_s": 1.5,
@@ -171,6 +174,7 @@ _FLAGS: Dict[str, Any] = {
     "profile_on_incident": True,
     "profile_max_samples": 200_000,
     "device_trace_steps": 0,
+    "device_trace_force": False,
     # --- perf regression plane (stability contract) -------------------------
     # Same contract as the profiling flags above: operators and CI key on
     # these names (perf.yml, README "Catching a perf regression").

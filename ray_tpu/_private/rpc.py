@@ -255,7 +255,21 @@ class RpcServer:
     def set_shard_safe(self, methods):
         """Mark methods whose handlers may run directly on a connection's
         shard loop (thread-safe by construction: pure reads or
-        natively-locked state). Everything else hops to the home loop."""
+        natively-locked state). Everything else hops to the home loop.
+
+        Raises at registration on a name with no registered handler: a
+        typo here is otherwise invisible — the real method silently keeps
+        hopping home, which is correct but quietly defeats the
+        optimization. Register handlers (register/register_all) first.
+        """
+        methods = set(methods)
+        unknown = sorted(m for m in methods if m not in self._handlers)
+        if unknown:
+            raise ValueError(
+                f"set_shard_safe: no registered handler for {unknown} "
+                f"(known: {sorted(self._handlers)[:20]}...); register "
+                "handlers before marking them shard-safe"
+            )
         self._shard_safe.update(methods)
 
     def set_oob_sink(self, method: str, sink: OobSink):

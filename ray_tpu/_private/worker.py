@@ -482,7 +482,7 @@ class CoreWorker:
             reply = await self.raylet.call("RegisterWorker", register_req)
             self.node_id = NodeID(reply["node_id"])
             self.plasma = PlasmaClient(reply["plasma_name"])
-        asyncio.ensure_future(self._task_event_flush_loop())
+        self._flush_task = asyncio.ensure_future(self._task_event_flush_loop())
         if self.mode == MODE_WORKER:
             asyncio.ensure_future(self._watch_raylet())
 
@@ -505,9 +505,23 @@ class CoreWorker:
 
     async def _task_event_flush_loop(self):
         period = RTPU_CONFIG.task_events_flush_period_ms / 1000.0
+        # Metrics ride this loop but on their own contract cadence
+        # (RTPU_metrics_report_period_ms): a busy worker flushing task
+        # events every second must not also re-push every gauge that often.
+        metrics_period = RTPU_CONFIG.metrics_report_period_ms / 1000.0
+        last_metrics_flush = 0.0
         idle_period = period
         while True:
             await asyncio.sleep(idle_period)
+            if self.is_shutdown:
+                # A worker outliving its cluster (init/shutdown cycles in
+                # one process — the io loop is a process singleton) must
+                # not keep draining the PROCESS-GLOBAL util.metrics
+                # records: its push would fail against the dead GCS and
+                # restore_records would re-merge, racing the live
+                # worker's flush for the same deltas — metrics then only
+                # export when the live worker happens to win the race.
+                return
             events = self.task_events.drain()
             if events:
                 idle_period = period
@@ -519,7 +533,10 @@ class CoreWorker:
                 # Idle worker: back off (cap 8x) — a fleet of parked actors
                 # shouldn't generate a constant wakeup storm.
                 idle_period = min(idle_period * 2, period * 8)
-            self._flush_user_metrics()
+            now = time.time()
+            if now - last_metrics_flush >= metrics_period:
+                last_metrics_flush = now
+                self._flush_user_metrics()
             # Keep the on-disk flight tail current (incremental append):
             # this is what lets the raylet read a SIGKILLed worker's last
             # events — no exit handler ever runs for SIGKILL.
@@ -999,11 +1016,16 @@ class CoreWorker:
         if self._direct is not None and self._direct.can_serve(refs):
             # Blocking resolve in THIS thread against the direct-channel
             # staging store — zero io-loop round trips (direct_channel.py).
+            # The slow-get hint is post-hoc here (no timer on the fast
+            # path; two clock reads are noise next to the socket wait).
+            t0 = time.time()
             out = self._direct.fast_get(refs, timeout)
             if out is not self._direct._FALLBACK:
+                self._warn_slow_get(len(refs), time.time() - t0)
                 return out
         deadline = None if timeout is None else time.time() + timeout
-        resolutions = self.io.run(self._async_resolve_many(refs, deadline))
+        resolutions = self._run_get_with_warning(
+            self._async_resolve_many(refs, deadline), len(refs), timeout)
         out = []
         for ref, res in zip(refs, resolutions):
             value = self._materialize(ref.object_id(), res)
@@ -1016,6 +1038,46 @@ class CoreWorker:
                 raise value
             out.append(value)
         return out
+
+    @staticmethod
+    def _warn_slow_get(n_refs: int, elapsed_s: float):
+        """Post-hoc arm of the slow-get hint (direct-channel fast path)."""
+        import sys as _sys
+
+        warn_s = RTPU_CONFIG.get_timeout_warning_s
+        if warn_s > 0 and elapsed_s >= warn_s:
+            print(
+                f"[ray_tpu] ray_tpu.get of {n_refs} ref(s) was blocked "
+                f"for {elapsed_s:.0f}s — the producing actor call may be "
+                "queued behind earlier calls or stalled (see "
+                "`ray-tpu debug incidents` / `ray-tpu timeline`)",
+                file=_sys.stderr, flush=True,
+            )
+
+    def _run_get_with_warning(self, coro, n_refs: int, timeout):
+        """Blocking wait on the io loop with the reference's slow-get
+        warning (RTPU_get_timeout_warning_s): a get blocked past the
+        threshold prints ONE hint naming the count so a driver stuck on a
+        never-produced ref is diagnosable before the stall watchdog fires.
+        0 disables; a caller timeout shorter than the threshold wins."""
+        import concurrent.futures as _cf
+        import sys as _sys
+
+        fut = self.io.post(coro)
+        warn_s = RTPU_CONFIG.get_timeout_warning_s
+        if warn_s <= 0 or (timeout is not None and timeout <= warn_s):
+            return fut.result()
+        try:
+            return fut.result(warn_s)
+        except _cf.TimeoutError:
+            print(
+                f"[ray_tpu] ray_tpu.get of {n_refs} ref(s) has been "
+                f"blocked for {warn_s:.0f}s — the producing task may be "
+                "queued, failed without a reply, or stalled (see "
+                "`ray-tpu debug incidents` / `ray-tpu timeline`)",
+                file=_sys.stderr, flush=True,
+            )
+            return fut.result()
 
     async def async_get_one(self, ref: ObjectRef):
         """IO-loop get used by the executor for dependency resolution."""
@@ -2741,6 +2803,14 @@ class CoreWorker:
             return
         self.is_shutdown = True
         set_worker_hooks(None)
+        # Stop the flush loop deterministically (the is_shutdown guard is
+        # the backstop) — see the zombie-drain note in the loop body.
+        flush_task = getattr(self, "_flush_task", None)
+        if flush_task is not None:
+            try:
+                self.io.loop.call_soon_threadsafe(flush_task.cancel)
+            except Exception:
+                pass
         if self._watchdog is not None:
             self._watchdog.stop()
         _fr.flush_now()

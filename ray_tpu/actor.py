@@ -18,7 +18,10 @@ _ACTOR_OPTION_DEFAULTS = dict(
     num_gpus=None,
     memory=None,
     resources=None,
-    max_restarts=0,
+    # None = RTPU_actor_max_restarts_default (0 unless overridden), so
+    # operators can give every actor a restart budget cluster-wide without
+    # touching call sites — mirrors max_retries in remote_function.py
+    max_restarts=None,
     max_task_retries=0,
     max_concurrency=None,
     name=None,
@@ -138,6 +141,11 @@ class ActorClass:
         resources = ts.normalize_resources(
             o["num_cpus"], o["num_tpus"], o["memory"], o["resources"], default_cpus=1.0
         )
+        max_restarts = o["max_restarts"]
+        if max_restarts is None:
+            from ray_tpu._private.config import RTPU_CONFIG
+
+            max_restarts = RTPU_CONFIG.actor_max_restarts_default
         actor_id = worker.create_actor(
             self._cls,
             args,
@@ -145,7 +153,7 @@ class ActorClass:
             name=o["name"] or "",
             namespace=o["namespace"] or "",
             resources=resources,
-            max_restarts=o["max_restarts"],
+            max_restarts=max_restarts,
             max_concurrency=o["max_concurrency"] or 1,
             lifetime=o["lifetime"] or "",
             scheduling_strategy=strategy_to_dict(o["scheduling_strategy"]),

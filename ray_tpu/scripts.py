@@ -36,6 +36,13 @@ Commands:
                                  (the CI A/B path, perf.yml)
   perf history [--metric M] [--limit N]
                                  print the perf ledger trajectory
+  lint [PATHS...] [--baseline F] [--update-baseline] [--json] [--verbose]
+                                 invariant lint plane: stability-contract
+                                 cross-check (flags/metrics/events/chaos
+                                 sites), shard-safety/thread-ownership
+                                 analysis, blocking-call-in-coroutine
+                                 detection; exit 1 on findings not in the
+                                 committed baseline (CI gate)
   job submit  --address ADDR -- ENTRYPOINT...
   job status  --address ADDR SUBMISSION_ID
   job logs    --address ADDR SUBMISSION_ID
@@ -792,6 +799,36 @@ def cmd_job(args):
             print(f"{j['submission_id']}  {j['status']:<10} {j['entrypoint']}")
 
 
+def cmd_lint(args):
+    from ray_tpu._private import lint as lint_mod
+
+    root = args.root or lint_mod.find_repo_root()
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        cand = os.path.join(root, lint_mod.DEFAULT_BASELINE)
+        if os.path.exists(cand):
+            baseline_path = cand
+    baseline = (
+        lint_mod.load_baseline(baseline_path) if baseline_path else None
+    )
+    result = lint_mod.run_lint(
+        paths=args.paths or None, root=root,
+        baseline=None if args.update_baseline else baseline,
+    )
+    if args.update_baseline:
+        path = baseline_path or os.path.join(root, lint_mod.DEFAULT_BASELINE)
+        n = lint_mod.save_baseline(path, result.findings)
+        print(f"wrote {n} accepted finding(s) to {path}")
+        return
+    if args.json:
+        json.dump(result.to_json(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(lint_mod.render_report(result, verbose=args.verbose))
+    if not result.ok:
+        sys.exit(1)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -920,6 +957,31 @@ def main(argv=None):
                    help="print one metric's trajectory")
     c.add_argument("--limit", type=int, default=0)
     c.set_defaults(fn=cmd_perf)
+
+    p = sub.add_parser(
+        "lint",
+        help="invariant lint plane: contract cross-check, shard-safety, "
+             "event-loop blocking-call detection (rule reference: "
+             "ray_tpu/_private/lint/__init__.py)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the ray_tpu package)")
+    p.add_argument("--baseline", default=None,
+                   help="accepted-findings file (default: "
+                        ".lint-baseline.json at the repo root if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="triage mode: write ALL current findings to the "
+                        "baseline and exit 0")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable ray_tpu.lint.v1 report (CI "
+                        "artifact mode)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print baseline-accepted findings")
+    p.add_argument("--root", default=None,
+                   help="repo root override (contracts + baseline resolve "
+                        "against it)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "debug", help="hang/crash forensics: dump archive, list incidents")

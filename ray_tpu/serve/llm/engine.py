@@ -897,6 +897,9 @@ class LLMReplica:
         bookkeeping. The chaos suite asserts ``problems == []`` and
         ``used_blocks == 0`` on every surviving replica after a storm —
         the serve-plane analogue of the PR 7 plasma leak sweep."""
+        # lint: allow(sync-lock-in-async) -- the engine's documented
+        # coarse lock; the probe runs between steps and never holds it
+        # across an await
         with self.engine._lock:
             problems = list(self.engine.cache.check_integrity())
             used = self.engine.cache.num_used_blocks

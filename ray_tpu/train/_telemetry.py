@@ -554,7 +554,9 @@ class DeviceTraceController:
         """Device tracing is a no-op on CPU or without a usable jax
         profiler — RTPU_device_trace_force=1 overrides (tests, host-trace
         debugging)."""
-        if os.environ.get("RTPU_device_trace_force") == "1":
+        from ray_tpu._private.config import RTPU_CONFIG
+
+        if RTPU_CONFIG.device_trace_force:
             return True
         try:
             import jax

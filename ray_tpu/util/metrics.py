@@ -92,6 +92,43 @@ change (add new series instead). The stable set:
                                        set size per process role on the
                                        node (worker = sum over workers)
 
+  node system series (raylet _collect_metrics, labels: node unless noted
+  — the Grafana cluster panels and `ray-tpu status` key on these)
+    ray_tpu_node_resource_total        gauge, labels +resource
+    ray_tpu_node_resource_available    gauge, labels +resource
+    ray_tpu_node_workers               gauge, labels +state (idle|leased)
+    ray_tpu_node_leases                gauge, outstanding worker leases
+    ray_tpu_node_pg_bundles            gauge, placed placement-group
+                                       bundles
+    ray_tpu_node_cpu_percent           gauge
+    ray_tpu_node_mem_used_bytes        gauge
+    ray_tpu_node_mem_total_bytes       gauge
+    ray_tpu_object_store_used_bytes    gauge
+    ray_tpu_object_store_capacity_bytes  gauge
+    ray_tpu_object_store_num_objects   gauge
+    ray_tpu_object_store_evicted_bytes gauge, cumulative
+    ray_tpu_spilled_objects            gauge, objects currently on disk
+    ray_tpu_spilled_bytes              gauge, bytes currently on disk
+    ray_tpu_pulls_in_flight            gauge
+    ray_tpu_worker_rss_bytes           gauge, labels +pid
+
+  GCS system series (gcs/server.py _collect_metrics)
+    ray_tpu_gcs_nodes                  gauge, labels: state
+    ray_tpu_gcs_actors                 gauge, labels: state
+    ray_tpu_gcs_placement_groups       gauge, labels: state
+    ray_tpu_gcs_jobs                   gauge, labels: state
+    ray_tpu_gcs_task_events_buffered   gauge
+    ray_tpu_gcs_incidents_open         gauge
+    ray_tpu_gcs_uptime_seconds         gauge
+
+  dashboard-agent host series (dashboard/agent.py, labels: node)
+    ray_tpu_agent_cpu_percent          gauge
+    ray_tpu_agent_mem_used_bytes       gauge
+    ray_tpu_agent_mem_total_bytes      gauge
+    ray_tpu_agent_uptime_seconds       gauge
+    ray_tpu_agent_disk_used_bytes      gauge
+    ray_tpu_agent_worker_rss_bytes     gauge, labels +pid
+
 The RTPU_profile_* / RTPU_device_trace_steps / RTPU_perf_* /
 RTPU_memory_* / RTPU_llm_* / RTPU_chaos_* / RTPU_serve_failover_* config
 flags are likewise a stability contract — see the profiling-plane,

@@ -188,6 +188,26 @@ def test_sharded_reactor_serves_many_connections():
 
 
 @pytest.mark.fast
+def test_set_shard_safe_rejects_unresolved_names():
+    """A typo'd set_shard_safe name used to silently keep the handler
+    hopping home — correct but quietly defeating the optimization. Now it
+    raises at registration, and the lint plane's shard-safe-unresolved
+    rule catches the literal form before a cluster even boots."""
+    server = RpcServer("127.0.0.1")
+
+    async def ping(payload):
+        return {"ok": True}
+
+    server.register("Ping", ping)
+    server.set_shard_safe({"Ping"})  # resolves: fine
+    with pytest.raises(ValueError, match="PingTypo"):
+        server.set_shard_safe({"PingTypo"})
+    # the failed call must not have poisoned the good registration
+    assert "Ping" in server._shard_safe
+    assert "PingTypo" not in server._shard_safe
+
+
+@pytest.mark.fast
 def test_shard_safe_handler_runs_on_shard_thread():
     async def main():
         server = RpcServer("127.0.0.1", shards=2)

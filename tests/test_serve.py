@@ -254,7 +254,12 @@ def test_request_metrics_and_latency_histogram_export(serve_cluster):
                 f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
             text = r.read().decode()
         if ("ray_tpu_serve_request_latency_seconds_bucket" in text
-                and "ray_tpu_serve_handle_latency_seconds_bucket" in text):
+                and "ray_tpu_serve_handle_latency_seconds_bucket" in text
+                # the gauges can land one metrics flush behind the
+                # histograms (RTPU_metrics_report_period_ms rate-limits
+                # the push) — wait for every asserted series
+                and "ray_tpu_serve_queue_depth" in text
+                and "ray_tpu_serve_inflight_requests" in text):
             break
         time.sleep(0.5)
     # replica-side series, labeled by deployment
